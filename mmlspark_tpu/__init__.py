@@ -1,0 +1,36 @@
+"""mmlspark_tpu — a TPU-native ML pipeline framework.
+
+A from-scratch reimplementation of the capabilities of MMLSpark
+(gdtm86/mmlspark): SparkML-style Estimator/Transformer pipelines with
+metadata-carrying schemas, implicit featurization, rich evaluation, image
+ingestion/processing, a pretrained-model zoo, and distributed DNN scoring and
+training — designed for TPUs.  Execution is JAX/XLA: `jit`-compiled array
+programs sharded over a `jax.sharding.Mesh` (ICI/DCN) replace the reference's
+CNTK-JNI bridge and MPI ring; batched XLA/Pallas kernels over HBM-resident
+image tensors replace per-row OpenCV JNI calls.
+
+Layer map (mirrors SURVEY.md section 1 of the reference analysis):
+  core/      - params DSL, schema metadata, pipeline kernel, table runtime
+  parallel/  - device mesh, sharding, collectives, multi-host init
+  ops/       - batched image/array kernels (XLA + Pallas)
+  models/    - flax model definitions + TPUModel distributed scoring
+  train/     - in-process distributed trainer (TPULearner)
+  ml/        - featurization, auto-ML train stages, evaluation
+  stages/    - utility pipeline stages
+  io/        - readers (image/binary/csv) and writers
+  zoo/       - pretrained model repository client
+  native/    - C++ host-side runtime pieces (decode, parse, hash)
+"""
+
+__version__ = "0.1.0"
+
+from mmlspark_tpu.core.params import Param, Params
+from mmlspark_tpu.core.pipeline import (
+    Estimator,
+    Pipeline,
+    PipelineModel,
+    PipelineStage,
+    Transformer,
+    load_stage,
+)
+from mmlspark_tpu.core.table import DataTable

@@ -1,0 +1,10 @@
+from mmlspark_tpu.core.params import Param, Params
+from mmlspark_tpu.core.pipeline import (
+    Estimator,
+    Pipeline,
+    PipelineModel,
+    PipelineStage,
+    Transformer,
+    load_stage,
+)
+from mmlspark_tpu.core.table import DataTable

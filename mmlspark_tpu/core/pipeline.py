@@ -1,0 +1,216 @@
+"""Pipeline kernel: PipelineStage / Transformer / Estimator / Pipeline.
+
+TPU-native counterpart of the SparkML pipeline contracts the reference builds
+everything on: stateless `Transformer.transform(table)`, `Estimator.fit(table)
+-> Transformer`, composable `Pipeline`, and save/load for every stage from day
+one (the reference's fuzzing harness, src/fuzzing/Fuzzing.scala:35-104, treats
+persistence + fit/transform as the universal invariants — we keep that).
+
+Persistence layout per stage directory:
+    stage.json   {"class": "pkg.mod.Class", "uid": ..., "params": {...}}
+    extra/       stage-specific payload (arrays, nested stages) via
+                 _save_extra/_load_extra hooks — the analogue of the
+                 reference's composite MLWriters (AssembleFeatures.scala:410-497).
+"""
+
+from __future__ import annotations
+
+import importlib
+import itertools
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Params
+from mmlspark_tpu.core.table import DataTable
+
+_uid_counters = itertools.count()
+
+
+def _fresh_uid(cls_name: str) -> str:
+    return f"{cls_name}_{next(_uid_counters):04d}"
+
+
+class PipelineStage(Params):
+    """Base of all pipeline stages; adds uid + persistence to Params."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.uid = _fresh_uid(type(self).__name__)
+
+    # -- persistence ----------------------------------------------------
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        cls = type(self)
+        payload = {
+            "class": f"{cls.__module__}.{cls.__qualname__}",
+            "uid": self.uid,
+            "params": {k: _param_to_json(v)
+                       for k, v in self.param_values(set_only=True).items()},
+        }
+        with open(os.path.join(path, "stage.json"), "w") as f:
+            json.dump(payload, f, indent=1)
+        extra = os.path.join(path, "extra")
+        os.makedirs(extra, exist_ok=True)
+        self._save_extra(extra)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineStage":
+        stage = load_stage(path)
+        if not isinstance(stage, cls):
+            raise TypeError(f"{path} holds {type(stage).__name__}, not {cls.__name__}")
+        return stage
+
+    def _save_extra(self, path: str) -> None:  # override for array state
+        pass
+
+    def _load_extra(self, path: str) -> None:
+        pass
+
+    def __repr__(self):
+        set_params = ", ".join(f"{k}={v!r}" for k, v in self._paramMap.items())
+        return f"{type(self).__name__}({set_params})"
+
+
+def load_stage(path: str) -> PipelineStage:
+    """Load any saved stage, dispatching on the recorded class path."""
+    with open(os.path.join(path, "stage.json")) as f:
+        payload = json.load(f)
+    module_name, _, qualname = payload["class"].rpartition(".")
+    module = importlib.import_module(module_name)
+    cls = module
+    for part in qualname.split("."):
+        cls = getattr(cls, part)
+    # Prefer the subclass constructor so instance state set in __init__
+    # exists on the loaded object; fall back to __new__ for stages whose
+    # __init__ requires arguments (they must restore state in _load_extra).
+    try:
+        stage = cls()
+    except TypeError:
+        stage = cls.__new__(cls)
+        PipelineStage.__init__(stage)
+    stage._paramMap = {}
+    stage.uid = payload["uid"]
+    for k, v in payload["params"].items():
+        stage.set(k, _param_from_json(v))
+    stage._load_extra(os.path.join(path, "extra"))
+    return stage
+
+
+def _param_to_json(v):
+    if isinstance(v, np.ndarray):
+        return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, tuple):
+        return list(v)
+    return v
+
+
+def _param_from_json(v):
+    if isinstance(v, dict) and "__ndarray__" in v:
+        return np.asarray(v["__ndarray__"], dtype=v["dtype"])
+    return v
+
+
+class Transformer(PipelineStage):
+    """A stateless table -> table mapping."""
+
+    def transform(self, table: DataTable) -> DataTable:
+        raise NotImplementedError
+
+    def __call__(self, table: DataTable) -> DataTable:
+        return self.transform(table)
+
+
+class Estimator(PipelineStage):
+    """Fits on a table, producing a Transformer (the "Model")."""
+
+    def fit(self, table: DataTable) -> Transformer:
+        raise NotImplementedError
+
+
+class Evaluator(Transformer):
+    """A transformer that computes metric tables (ComputeModelStatistics style)."""
+
+
+class Pipeline(Estimator):
+    """Sequence of stages; fit() fits estimators in order, threading transforms.
+
+    Mirrors SparkML Pipeline semantics the reference relies on
+    (e.g. TrainClassifier.scala:158-159).
+    """
+
+    def __init__(self, stages: Optional[Sequence[PipelineStage]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._stages: list[PipelineStage] = list(stages or [])
+
+    def get_stages(self) -> list[PipelineStage]:
+        return list(self._stages)
+
+    def set_stages(self, stages: Sequence[PipelineStage]) -> "Pipeline":
+        self._stages = list(stages)
+        return self
+
+    def fit(self, table: DataTable) -> "PipelineModel":
+        fitted: list[Transformer] = []
+        current = table
+        for i, stage in enumerate(self._stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+            elif isinstance(stage, Transformer):
+                model = stage
+            else:
+                raise TypeError(f"stage {i} ({stage!r}) is neither Estimator "
+                                f"nor Transformer")
+            if i < len(self._stages) - 1:
+                current = model.transform(current)
+            fitted.append(model)
+        return PipelineModel(fitted)
+
+    def _save_extra(self, path: str) -> None:
+        _save_stage_list(path, self._stages)
+
+    def _load_extra(self, path: str) -> None:
+        self._stages = _load_stage_list(path)
+
+
+class PipelineModel(Transformer):
+    """The fitted pipeline: applies each stage's transform in order."""
+
+    def __init__(self, stages: Optional[Sequence[Transformer]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._stages: list[Transformer] = list(stages or [])
+
+    def get_stages(self) -> list[Transformer]:
+        return list(self._stages)
+
+    def transform(self, table: DataTable) -> DataTable:
+        current = table
+        for stage in self._stages:
+            current = stage.transform(current)
+        return current
+
+    def _save_extra(self, path: str) -> None:
+        _save_stage_list(path, self._stages)
+
+    def _load_extra(self, path: str) -> None:
+        self._stages = _load_stage_list(path)
+
+
+def _save_stage_list(path: str, stages: Sequence[PipelineStage]) -> None:
+    with open(os.path.join(path, "stages.json"), "w") as f:
+        json.dump({"count": len(stages)}, f)
+    for i, stage in enumerate(stages):
+        stage.save(os.path.join(path, f"stage_{i:03d}"))
+
+
+def _load_stage_list(path: str) -> list:
+    with open(os.path.join(path, "stages.json")) as f:
+        count = json.load(f)["count"]
+    return [load_stage(os.path.join(path, f"stage_{i:03d}"))
+            for i in range(count)]

@@ -1,0 +1,290 @@
+"""Column-metadata protocol: score kinds, categorical levels, image schema.
+
+TPU-native counterpart of the reference's metadata-driven schema system
+(reference: src/core/schema/src/main/scala/SparkSchema.scala:183-245,
+SchemaConstants.scala:9-43, Categoricals.scala:17-261, ImageSchema.scala:18-23,
+BinaryFileSchema.scala:14-17).
+
+The reference smuggles ML semantics through Spark column `Metadata` under an
+`mml` tag: which columns are scores, which model produced them, what the
+categorical levels are.  Here the same protocol lives in `ColumnMeta` objects
+carried by `DataTable` (core/table.py) — evaluators like
+ComputeModelStatistics discover the scored-label/score columns by metadata,
+never by hard-coded names, exactly as the reference does
+(ComputeModelStatistics.scala:205-218).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Optional
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# SchemaConstants (reference SchemaConstants.scala:9-43)
+# --------------------------------------------------------------------------
+
+class SchemaConstants:
+    MML_TAG = "mml"                     # metadata namespace tag
+    SCORE_MODEL_PREFIX = "score_model"  # value identifying the producing model
+    SCORE_COLUMN_KIND = "score_column_kind"
+
+    # score column kinds
+    SCORES_COLUMN = "scores"
+    SCORED_LABELS_COLUMN = "scored_labels"
+    SCORED_PROBABILITIES_COLUMN = "scored_probabilities"
+    TRUE_LABELS_COLUMN = "true_labels"
+
+    # model categories
+    CLASSIFICATION_KIND = "classification"
+    REGRESSION_KIND = "regression"
+
+    SPARK_PREDICTION_COLUMN = "prediction"
+
+
+@dataclasses.dataclass
+class CategoricalMap:
+    """Bidirectional value<->index map for a categorical column.
+
+    Reference: CategoricalMap, Categoricals.scala:186-261.  `levels[i]` is the
+    raw value encoded as index i; `has_null_level` marks a reserved index for
+    missing values (the reference's MML-style null level).
+    """
+
+    levels: list
+    ordinal: bool = False
+    has_null_level: bool = False
+
+    def __post_init__(self):
+        self._index: dict = {v: i for i, v in enumerate(self.levels)}
+
+    @property
+    def num_levels(self) -> int:
+        return len(self.levels)
+
+    def get_index(self, value, default: int = -1) -> int:
+        return self._index.get(value, default)
+
+    def get_level(self, index: int):
+        return self.levels[index]
+
+    def to_indices(self, values) -> np.ndarray:
+        return np.asarray([self._index.get(v, -1) for v in values], dtype=np.int32)
+
+    def to_levels(self, indices) -> np.ndarray:
+        idx = np.asarray(indices, dtype=np.int64)
+        if ((idx < 0) | (idx >= len(self.levels))).any():
+            out = np.empty(len(idx), dtype=object)
+            for i, j in enumerate(idx):
+                out[i] = self.levels[j] if 0 <= j < len(self.levels) else None
+            return out
+        arr = np.asarray(self.levels, dtype=object)
+        return arr[idx]
+
+    # persistence ----------------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "levels": [_json_scalar(v) for v in self.levels],
+            "ordinal": self.ordinal,
+            "has_null_level": self.has_null_level,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "CategoricalMap":
+        return CategoricalMap(list(d["levels"]), bool(d.get("ordinal", False)),
+                              bool(d.get("has_null_level", False)))
+
+
+def _json_scalar(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if isinstance(v, np.str_):
+        return str(v)
+    return v
+
+
+# --------------------------------------------------------------------------
+# Image / binary-file schemas (reference ImageSchema.scala:18-23,
+# BinaryFileSchema.scala:14-17)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ImageSchema:
+    """Shape/layout contract for an image column.
+
+    An image column in a DataTable is a numpy uint8 array of shape
+    (rows, height, width, channels) — batched HWC, the layout host decoders
+    produce — plus this metadata.  The reference kept per-row
+    (path, height, width, type, bytes) structs; batching is the TPU-native
+    re-design: images live as one dense tensor ready for device transfer.
+    """
+
+    height: int
+    width: int
+    channels: int = 3
+    color_space: str = "BGR"  # reference uses OpenCV BGR byte order
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "ImageSchema":
+        return ImageSchema(**d)
+
+
+@dataclasses.dataclass
+class BinaryFileSchema:
+    """Marks a column of raw file bytes (list of `bytes`), with paths alongside."""
+
+    path_col: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "BinaryFileSchema":
+        return BinaryFileSchema(**d)
+
+
+# --------------------------------------------------------------------------
+# ColumnMeta — the per-column metadata record
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ColumnMeta:
+    """Everything the `mml` metadata tag carried in the reference.
+
+    score_model / score_kind / model_kind implement the scored-columns
+    protocol (SparkSchema.scala:183-245); categorical carries levels
+    (Categoricals.scala); image/binary mark tensorized payload columns.
+    """
+
+    score_model: Optional[str] = None      # uid of producing model
+    score_kind: Optional[str] = None       # one of SchemaConstants.*_COLUMN
+    model_kind: Optional[str] = None       # classification | regression
+    categorical: Optional[CategoricalMap] = None
+    image: Optional[ImageSchema] = None
+    binary: Optional[BinaryFileSchema] = None
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    def copy(self) -> "ColumnMeta":
+        return ColumnMeta(
+            score_model=self.score_model,
+            score_kind=self.score_kind,
+            model_kind=self.model_kind,
+            categorical=self.categorical,
+            image=self.image,
+            binary=self.binary,
+            extra=dict(self.extra),
+        )
+
+    @property
+    def is_categorical(self) -> bool:
+        return self.categorical is not None
+
+    def to_json(self) -> dict:
+        d: dict[str, Any] = {}
+        if self.score_model is not None:
+            d["score_model"] = self.score_model
+        if self.score_kind is not None:
+            d["score_kind"] = self.score_kind
+        if self.model_kind is not None:
+            d["model_kind"] = self.model_kind
+        if self.categorical is not None:
+            d["categorical"] = self.categorical.to_json()
+        if self.image is not None:
+            d["image"] = self.image.to_json()
+        if self.binary is not None:
+            d["binary"] = self.binary.to_json()
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "ColumnMeta":
+        return ColumnMeta(
+            score_model=d.get("score_model"),
+            score_kind=d.get("score_kind"),
+            model_kind=d.get("model_kind"),
+            categorical=CategoricalMap.from_json(d["categorical"]) if "categorical" in d else None,
+            image=ImageSchema.from_json(d["image"]) if "image" in d else None,
+            binary=BinaryFileSchema.from_json(d["binary"]) if "binary" in d else None,
+            extra=d.get("extra", {}),
+        )
+
+
+# --------------------------------------------------------------------------
+# Schema helpers (reference SparkSchema.scala object methods)
+# --------------------------------------------------------------------------
+
+_score_tag_seq = itertools.count(1)
+
+
+def set_score_column(table, model_uid: str, column: str, score_kind: str,
+                     model_kind: str) -> None:
+    """Tag `column` as a score column produced by `model_uid` (in place).
+
+    Reference: SparkSchema.setColumnName/updateMetadata, SparkSchema.scala:183-236.
+    """
+    meta = table.meta(column)
+    meta.score_model = model_uid
+    meta.score_kind = score_kind
+    meta.model_kind = model_kind
+    meta.extra["score_seq"] = next(_score_tag_seq)
+    table.set_meta(column, meta)
+
+
+def find_score_columns(table, model_uid: Optional[str] = None) -> dict[str, str]:
+    """Map score_kind -> column name for columns tagged by `model_uid`.
+
+    If model_uid is None, uses the most recently tagged model (the reference
+    evaluator picks the scores of "the" model in the DataFrame the same way,
+    ComputeModelStatistics.scala:205-218, 523-530).  Recency is tracked by a
+    tagging sequence number, not column order.
+    """
+    tagged = {c: m for c in table.columns
+              if (m := table.meta(c)).score_model is not None}
+    if model_uid is None:
+        if not tagged:
+            return {}
+        latest = max(tagged.values(), key=lambda m: m.extra.get("score_seq", 0))
+        model_uid = latest.score_model
+    return {m.score_kind: c for c, m in tagged.items() if m.score_model == model_uid}
+
+
+def make_categorical(table, column: str, levels: Optional[list] = None,
+                     ordinal: bool = False, output_col: Optional[str] = None):
+    """Encode a column to categorical indices with levels in metadata.
+
+    Reference: SparkSchema.makeCategorical, SparkSchema.scala:255-307.
+    Returns a new table where `output_col` (default: in place) holds int32
+    indices and carries a CategoricalMap.
+    """
+    values = table[column]
+    vals_list = list(values.tolist() if isinstance(values, np.ndarray) else values)
+    if levels is None:
+        seen: dict = {}
+        for v in vals_list:
+            if v not in seen:
+                seen[v] = len(seen)
+        levels = sorted(seen, key=lambda v: (str(type(v)), str(v))) if not ordinal \
+            else list(seen)
+    cmap = CategoricalMap(list(levels), ordinal=ordinal)
+    indices = cmap.to_indices(vals_list)
+    out = output_col or column
+    new = table.with_column(out, indices)
+    meta = new.meta(out)
+    meta.categorical = cmap
+    new.set_meta(out, meta)
+    return new
+
+
+def get_categorical_map(table, column: str) -> Optional[CategoricalMap]:
+    return table.meta(column).categorical

@@ -1,0 +1,316 @@
+"""DataTable: host-side columnar table with ML metadata.
+
+The TPU-native replacement for Spark DataFrames.  The reference distributes
+rows across Spark partitions and runs per-row JVM/JNI UDF loops
+(ImageTransformer.scala:272-304, CNTKModel.scala:50-104); here a table is a
+dict of contiguous numpy columns living on the host, whose numeric/image
+columns materialize as (sharded) `jax.Array`s only at the device boundary —
+so every per-row loop in the reference becomes one batched XLA program.
+
+Partitioning survives as `num_shards`, a layout hint consumed by the parallel
+layer (repartition == resharding over the mesh, reference Repartition.scala).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterator, Mapping, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import ColumnMeta, _json_scalar
+
+
+def _as_column(values: Any) -> np.ndarray:
+    if isinstance(values, np.ndarray):
+        return values
+    values = list(values)
+    if values and isinstance(values[0], (str, bytes, dict)) or any(
+            v is None for v in values):
+        arr = np.empty(len(values), dtype=object)
+        arr[:] = values
+        return arr
+    try:
+        return np.asarray(values)
+    except ValueError:  # ragged
+        arr = np.empty(len(values), dtype=object)
+        arr[:] = values
+        return arr
+
+
+class DataTable:
+    """Immutable-by-convention columnar table.
+
+    Mutating helpers (`set_meta`) mutate metadata only; all data-shaping
+    methods return new DataTables sharing column buffers (zero-copy where
+    possible).
+    """
+
+    def __init__(
+        self,
+        columns: Mapping[str, Any],
+        metadata: Optional[Mapping[str, ColumnMeta]] = None,
+        num_shards: int = 1,
+    ):
+        self._cols: dict[str, np.ndarray] = {}
+        n = None
+        for name, vals in columns.items():
+            arr = _as_column(vals)
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise ValueError(
+                    f"column '{name}' has {len(arr)} rows, expected {n}")
+            self._cols[name] = arr
+        self._meta: dict[str, ColumnMeta] = {
+            name: (metadata[name].copy() if metadata and name in metadata
+                   else ColumnMeta())
+            for name in self._cols
+        }
+        self.num_shards = max(1, int(num_shards))
+
+    # -- construction --------------------------------------------------
+    @staticmethod
+    def from_dict(d: Mapping[str, Any], **kw) -> "DataTable":
+        return DataTable(d, **kw)
+
+    @staticmethod
+    def from_pandas(df, **kw) -> "DataTable":
+        cols = {}
+        for name in df.columns:
+            s = df[name]
+            if s.dtype == object:
+                cols[name] = s.to_numpy(dtype=object)
+            else:
+                cols[name] = s.to_numpy()
+        return DataTable(cols, **kw)
+
+    @staticmethod
+    def from_rows(rows: Sequence[Mapping[str, Any]], **kw) -> "DataTable":
+        if not rows:
+            return DataTable({}, **kw)
+        names = list(rows[0].keys())
+        return DataTable({n: [r[n] for r in rows] for n in names}, **kw)
+
+    @staticmethod
+    def read_csv(path: str, **kw) -> "DataTable":
+        import pandas as pd
+        return DataTable.from_pandas(pd.read_csv(path), **kw)
+
+    def to_pandas(self):
+        import pandas as pd
+        out = {}
+        for name, arr in self._cols.items():
+            out[name] = list(arr) if arr.ndim > 1 else arr
+        return pd.DataFrame(out)
+
+    # -- basic accessors -----------------------------------------------
+    @property
+    def columns(self) -> list[str]:
+        return list(self._cols)
+
+    @property
+    def num_rows(self) -> int:
+        if not self._cols:
+            return 0
+        return len(next(iter(self._cols.values())))
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        try:
+            return self._cols[name]
+        except KeyError:
+            raise KeyError(
+                f"no column '{name}'; available: {self.columns}") from None
+
+    def column(self, name: str) -> np.ndarray:
+        return self[name]
+
+    def meta(self, name: str) -> ColumnMeta:
+        self[name]
+        return self._meta[name]
+
+    def set_meta(self, name: str, meta: ColumnMeta) -> None:
+        self[name]
+        self._meta[name] = meta
+
+    def schema(self) -> dict[str, tuple]:
+        return {n: (str(a.dtype), a.shape[1:]) for n, a in self._cols.items()}
+
+    def rows(self) -> Iterator[dict]:
+        for i in range(self.num_rows):
+            yield {n: a[i] for n, a in self._cols.items()}
+
+    # -- shaping (all return new tables) --------------------------------
+    def _derive(self, cols: dict[str, np.ndarray],
+                meta: Optional[dict[str, ColumnMeta]] = None) -> "DataTable":
+        t = DataTable.__new__(DataTable)
+        t._cols = cols
+        src_meta = meta if meta is not None else self._meta
+        t._meta = {n: (src_meta[n].copy() if n in src_meta else ColumnMeta())
+                   for n in cols}
+        t.num_shards = self.num_shards
+        return t
+
+    def select(self, *names: str) -> "DataTable":
+        return self._derive({n: self[n] for n in names})
+
+    def drop(self, *names: str) -> "DataTable":
+        return self._derive({n: a for n, a in self._cols.items() if n not in names})
+
+    def with_column(self, name: str, values: Any,
+                    meta: Optional[ColumnMeta] = None) -> "DataTable":
+        arr = _as_column(values)
+        if self._cols and len(arr) != self.num_rows:
+            raise ValueError(
+                f"column '{name}' has {len(arr)} rows, table has {self.num_rows}")
+        cols = dict(self._cols)
+        cols[name] = arr
+        out = self._derive(cols)
+        if meta is not None:
+            out._meta[name] = meta.copy()
+        elif name not in self._meta:
+            out._meta[name] = ColumnMeta()
+        return out
+
+    def rename(self, mapping: Mapping[str, str]) -> "DataTable":
+        cols = {mapping.get(n, n): a for n, a in self._cols.items()}
+        meta = {mapping.get(n, n): m for n, m in self._meta.items()}
+        return self._derive(cols, meta)
+
+    def filter(self, mask: Any) -> "DataTable":
+        mask = np.asarray(mask)
+        return self._derive({n: a[mask] for n, a in self._cols.items()})
+
+    def take(self, n: int) -> "DataTable":
+        return self._derive({name: a[:n] for name, a in self._cols.items()})
+
+    def slice(self, start: int, stop: int) -> "DataTable":
+        return self._derive({n: a[start:stop] for n, a in self._cols.items()})
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataTable":
+        rng = np.random.default_rng(seed)
+        mask = rng.random(self.num_rows) < fraction
+        return self.filter(mask)
+
+    def shuffle(self, seed: int = 0) -> "DataTable":
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self.num_rows)
+        return self._derive({n: a[perm] for n, a in self._cols.items()})
+
+    def concat(self, other: "DataTable") -> "DataTable":
+        if set(self.columns) != set(other.columns):
+            raise ValueError(
+                f"column mismatch: {self.columns} vs {other.columns}")
+        cols = {n: np.concatenate([self[n], other[n]], axis=0)
+                for n in self.columns}
+        return self._derive(cols)
+
+    def repartition(self, num_shards: int) -> "DataTable":
+        """Resharding hint (reference Repartition.scala:15-42)."""
+        out = self._derive(dict(self._cols))
+        out.num_shards = max(1, int(num_shards))
+        return out
+
+    def drop_nulls(self, subset: Optional[Sequence[str]] = None) -> "DataTable":
+        names = list(subset) if subset else self.columns
+        mask = np.ones(self.num_rows, dtype=bool)
+        for n in names:
+            a = self[n]
+            if a.dtype == object:
+                mask &= np.asarray([v is not None for v in a])
+            elif np.issubdtype(a.dtype, np.floating):
+                ax = tuple(range(1, a.ndim))
+                mask &= ~np.isnan(a).any(axis=ax) if a.ndim > 1 else ~np.isnan(a)
+        return self.filter(mask)
+
+    def find_unused_column_name(self, prefix: str) -> str:
+        """Reference: DatasetExtensions.findUnusedColumnName, DatasetExtensions.scala:58."""
+        name, i = prefix, 0
+        while name in self._cols:
+            i += 1
+            name = f"{prefix}_{i}"
+        return name
+
+    # -- batching (the applyModel minibatcher, CNTKModel.scala:50-104) ---
+    def batches(self, columns: Sequence[str], batch_size: int,
+                pad: bool = True) -> Iterator[tuple[dict[str, np.ndarray], int]]:
+        """Yield (column-dict, valid_count) minibatches.
+
+        The last batch is zero-padded to `batch_size` when `pad` — static
+        shapes keep XLA from recompiling per remainder (the reference padded
+        for a CNTK batch-size bug, CNTKModel.scala:71-76; here padding is a
+        compilation-model requirement, not a workaround).
+        """
+        n = self.num_rows
+        for start in range(0, n, batch_size):
+            stop = min(start + batch_size, n)
+            valid = stop - start
+            batch = {c: self[c][start:stop] for c in columns}
+            if pad and valid < batch_size:
+                batch = {
+                    c: np.concatenate(
+                        [a, np.zeros((batch_size - valid,) + a.shape[1:], a.dtype)])
+                    for c, a in batch.items()
+                }
+            yield batch, valid
+
+    # -- persistence ----------------------------------------------------
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        obj_cols, arr_cols = {}, {}
+        for n, a in self._cols.items():
+            (obj_cols if a.dtype == object else arr_cols)[n] = a
+        np.savez(os.path.join(path, "columns.npz"), **arr_cols)
+        with open(os.path.join(path, "objects.json"), "w") as f:
+            json.dump({n: [_obj_to_json(v) for v in a]
+                       for n, a in obj_cols.items()}, f)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({
+                "num_shards": self.num_shards,
+                "column_order": self.columns,
+                "metadata": {n: m.to_json() for n, m in self._meta.items()},
+            }, f)
+
+    @staticmethod
+    def load(path: str) -> "DataTable":
+        with open(os.path.join(path, "meta.json")) as f:
+            info = json.load(f)
+        npz = np.load(os.path.join(path, "columns.npz"), allow_pickle=False)
+        with open(os.path.join(path, "objects.json")) as f:
+            objs = json.load(f)
+        cols: dict[str, np.ndarray] = {}
+        for n in info["column_order"]:
+            cols[n] = npz[n] if n in npz.files else _as_column(
+                [_obj_from_json(v) for v in objs[n]])
+        meta = {n: ColumnMeta.from_json(m) for n, m in info["metadata"].items()}
+        return DataTable(cols, metadata=meta, num_shards=info["num_shards"])
+
+    def __repr__(self):
+        schema = ", ".join(f"{n}:{d}{list(s) if s else ''}"
+                           for n, (d, s) in self.schema().items())
+        return f"DataTable[{self.num_rows} rows; {schema}]"
+
+
+def _obj_to_json(v):
+    if isinstance(v, bytes):
+        import base64
+        return {"__bytes__": base64.b64encode(v).decode()}
+    if isinstance(v, np.ndarray):
+        return {"__array__": v.tolist(), "dtype": str(v.dtype)}
+    return _json_scalar(v)
+
+
+def _obj_from_json(v):
+    if isinstance(v, dict) and "__bytes__" in v:
+        import base64
+        return base64.b64decode(v["__bytes__"])
+    if isinstance(v, dict) and "__array__" in v:
+        return np.asarray(v["__array__"], dtype=v["dtype"])
+    return v

@@ -1,0 +1,34 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip hardware is not available in CI; sharding/collective tests run on
+8 virtual CPU devices (the reference's analogue was local[*] Spark sessions,
+SparkSessionFactory.scala:40-51 — all "distributed" tests single-host).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture
+def small_table():
+    from mmlspark_tpu import DataTable
+    return DataTable({
+        "numbers": np.arange(10, dtype=np.float32),
+        "words": [f"w{i % 3}" for i in range(10)],
+        "label": np.array([i % 2 for i in range(10)], dtype=np.int32),
+        "feats": np.arange(30, dtype=np.float32).reshape(10, 3),
+    })

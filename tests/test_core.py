@@ -1,0 +1,236 @@
+"""Core tests: params DSL, schema metadata, DataTable, pipeline kernel."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataTable, Estimator, Pipeline, PipelineModel, Transformer
+from mmlspark_tpu.core.params import Param, ParamError, Params
+from mmlspark_tpu.core.pipeline import load_stage
+from mmlspark_tpu.core.schema import (
+    CategoricalMap,
+    ColumnMeta,
+    ImageSchema,
+    SchemaConstants,
+    find_score_columns,
+    make_categorical,
+    set_score_column,
+)
+
+
+# ---------------------------------------------------------------- params ---
+
+class _Stage(Params):
+    alpha = Param(1.0, "learning rate", ptype=float, validator=lambda v: v > 0)
+    mode = Param("fast", "mode", domain=("fast", "slow"))
+    name = Param(None, "a name", ptype=str)
+
+
+def test_param_defaults_and_set():
+    s = _Stage()
+    assert s.alpha == 1.0 and s.mode == "fast" and s.name is None
+    s.alpha = 0.5
+    assert s.alpha == 0.5
+    assert s.is_set("alpha") and not s.is_set("mode")
+
+
+def test_param_validation():
+    s = _Stage()
+    with pytest.raises(ParamError):
+        s.alpha = -1.0
+    with pytest.raises(ParamError):
+        s.mode = "medium"
+    with pytest.raises(ParamError):
+        s.set("nonexistent", 1)
+    s.alpha = 2  # int -> float coercion
+    assert s.alpha == 2.0 and isinstance(s.alpha, float)
+
+
+def test_param_copy_independent():
+    s = _Stage(alpha=3.0)
+    c = s.copy(mode="slow")
+    assert c.alpha == 3.0 and c.mode == "slow"
+    c.alpha = 9.0
+    assert s.alpha == 3.0
+
+
+def test_params_introspection():
+    assert set(_Stage.params()) == {"alpha", "mode", "name"}
+    assert "learning rate" in _Stage().explain_params()
+
+
+# ---------------------------------------------------------------- schema ---
+
+def test_categorical_map_roundtrip():
+    cm = CategoricalMap(["a", "b", "c"])
+    assert cm.get_index("b") == 1
+    assert list(cm.to_indices(["c", "a", "zzz"])) == [2, 0, -1]
+    assert list(cm.to_levels([1, 1, 0])) == ["b", "b", "a"]
+    cm2 = CategoricalMap.from_json(cm.to_json())
+    assert cm2.levels == cm.levels
+
+
+def test_make_categorical(small_table):
+    t = make_categorical(small_table, "words")
+    assert t["words"].dtype == np.int32
+    cmap = t.meta("words").categorical
+    assert cmap is not None and cmap.num_levels == 3
+    decoded = cmap.to_levels(t["words"])
+    assert list(decoded) == [f"w{i % 3}" for i in range(10)]
+
+
+def test_score_column_protocol(small_table):
+    t = small_table.with_column("scores", np.zeros((10, 2), np.float32))
+    set_score_column(t, "model_1", "scores", SchemaConstants.SCORES_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    cols = find_score_columns(t)
+    assert cols == {SchemaConstants.SCORES_COLUMN: "scores"}
+    assert t.meta("scores").model_kind == SchemaConstants.CLASSIFICATION_KIND
+
+
+def test_column_meta_json_roundtrip():
+    m = ColumnMeta(score_model="m1", score_kind="scores",
+                   categorical=CategoricalMap([1, 2]),
+                   image=ImageSchema(32, 32, 3))
+    m2 = ColumnMeta.from_json(m.to_json())
+    assert m2.score_model == "m1" and m2.categorical.levels == [1, 2]
+    assert m2.image.height == 32
+
+
+# ----------------------------------------------------------------- table ---
+
+def test_table_basics(small_table):
+    t = small_table
+    assert t.num_rows == 10
+    assert set(t.columns) == {"numbers", "words", "label", "feats"}
+    assert t["feats"].shape == (10, 3)
+    sel = t.select("numbers", "label")
+    assert sel.columns == ["numbers", "label"]
+    assert t.drop("words").columns == ["numbers", "label", "feats"]
+
+
+def test_table_with_column_and_filter(small_table):
+    t = small_table.with_column("double", small_table["numbers"] * 2)
+    assert t["double"][3] == 6.0
+    f = t.filter(t["label"] == 1)
+    assert f.num_rows == 5
+    with pytest.raises(ValueError):
+        small_table.with_column("bad", np.zeros(3))
+
+
+def test_table_metadata_preserved_through_ops(small_table):
+    t = make_categorical(small_table, "words")
+    t2 = t.select("words", "label").filter(t["label"] == 0)
+    assert t2.meta("words").categorical is not None
+
+
+def test_table_batches_padding(small_table):
+    batches = list(small_table.batches(["feats"], batch_size=4))
+    assert len(batches) == 3
+    (b0, v0), (_, v1), (b2, v2) = batches
+    assert b0["feats"].shape == (4, 3) and v0 == 4
+    assert b2["feats"].shape == (4, 3) and v2 == 2
+    assert np.all(b2["feats"][2:] == 0)
+
+
+def test_table_save_load(tmp_path, small_table):
+    t = make_categorical(small_table, "words")
+    t.save(str(tmp_path / "tbl"))
+    t2 = DataTable.load(str(tmp_path / "tbl"))
+    assert t2.num_rows == 10
+    assert t2.columns == t.columns
+    np.testing.assert_array_equal(t2["feats"], t["feats"])
+    assert t2.meta("words").categorical.levels == t.meta("words").categorical.levels
+
+
+def test_table_concat_shuffle_sample(small_table):
+    c = small_table.concat(small_table)
+    assert c.num_rows == 20
+    s = c.shuffle(seed=1)
+    assert s.num_rows == 20 and not np.array_equal(s["numbers"], c["numbers"])
+    assert 0 < c.sample(0.5, seed=2).num_rows < 20
+
+
+def test_drop_nulls():
+    t = DataTable({"a": np.array([1.0, np.nan, 3.0]),
+                   "s": ["x", None, "y"]})
+    assert t.drop_nulls(["a"]).num_rows == 2
+    assert t.drop_nulls().num_rows == 2
+
+
+def test_find_unused_column_name(small_table):
+    assert small_table.find_unused_column_name("fresh") == "fresh"
+    assert small_table.find_unused_column_name("numbers") == "numbers_1"
+
+
+# -------------------------------------------------------------- pipeline ---
+
+class AddConstant(Transformer):
+    inputCol = Param("numbers", "input column", ptype=str)
+    outputCol = Param("out", "output column", ptype=str)
+    value = Param(1.0, "constant to add", ptype=float)
+
+    def transform(self, table):
+        return table.with_column(self.outputCol, table[self.inputCol] + self.value)
+
+
+class MeanCenterer(Estimator):
+    inputCol = Param("numbers", "input column", ptype=str)
+    outputCol = Param("centered", "output column", ptype=str)
+
+    def fit(self, table):
+        m = CenterModel(inputCol=self.inputCol, outputCol=self.outputCol)
+        m.mean_ = float(np.mean(table[self.inputCol]))
+        return m
+
+
+class CenterModel(Transformer):
+    inputCol = Param("numbers", "input column", ptype=str)
+    outputCol = Param("centered", "output column", ptype=str)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.mean_ = 0.0
+
+    def transform(self, table):
+        return table.with_column(self.outputCol, table[self.inputCol] - self.mean_)
+
+    def _save_extra(self, path):
+        np.savez(f"{path}/state.npz", mean=self.mean_)
+
+    def _load_extra(self, path):
+        self.mean_ = float(np.load(f"{path}/state.npz")["mean"])
+
+
+def test_pipeline_fit_transform(small_table):
+    pipe = Pipeline([MeanCenterer(), AddConstant(inputCol="centered", value=10.0)])
+    model = pipe.fit(small_table)
+    out = model.transform(small_table)
+    assert abs(float(np.mean(out["centered"]))) < 1e-6
+    np.testing.assert_allclose(out["out"], out["centered"] + 10.0)
+
+
+def test_stage_save_load_roundtrip(tmp_path, small_table):
+    t = AddConstant(value=5.0)
+    t.save(str(tmp_path / "t"))
+    t2 = load_stage(str(tmp_path / "t"))
+    assert isinstance(t2, AddConstant) and t2.value == 5.0
+    np.testing.assert_array_equal(
+        t2.transform(small_table)["out"], small_table["numbers"] + 5.0)
+
+
+def test_pipeline_model_save_load(tmp_path, small_table):
+    model = Pipeline([MeanCenterer(), AddConstant(inputCol="centered")]).fit(small_table)
+    model.save(str(tmp_path / "pm"))
+    loaded = PipelineModel.load(str(tmp_path / "pm"))
+    out1 = model.transform(small_table)
+    out2 = loaded.transform(small_table)
+    np.testing.assert_allclose(out1["out"], out2["out"])
+
+
+def test_unfitted_pipeline_save_load(tmp_path, small_table):
+    pipe = Pipeline([MeanCenterer(inputCol="numbers"), AddConstant()])
+    pipe.save(str(tmp_path / "p"))
+    p2 = Pipeline.load(str(tmp_path / "p"))
+    assert len(p2.get_stages()) == 2
+    out = p2.fit(small_table).transform(small_table)
+    assert "out" in out.columns

@@ -1,0 +1,63 @@
+"""Headline benchmark: CIFAR-10 ConvNet scoring throughput (images/sec/chip).
+
+Measures the TPUModel.transform path end-to-end — host batching, device
+transfer, jit forward, fetch — i.e. the replacement for the reference's
+CNTKModel per-partition JNI scoring loop (CNTKModel.scala:50-104, the
+notebook-301 workload).
+
+Baseline arithmetic (BASELINE.json north_star): beat 4x the 4xK80 Azure
+N-series CNTK path.  The reference publishes no throughput number; we take
+~1000 img/s per K80 for this ConvNet class (typical CNTK-era measurement),
+so 4 chips ~= 4000 img/s and the 4x target is 16000 img/s.  vs_baseline
+reported here is measured / 16000.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+TARGET_IMAGES_PER_SEC = 16000.0
+N_IMAGES = 32768
+BATCH = 4096
+
+
+def main():
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.models import ConvNetCIFAR10, ModelBundle, TPUModel
+
+    module = ConvNetCIFAR10()  # bfloat16 compute on the MXU
+    bundle = ModelBundle.init(module, (1, 32, 32, 3), seed=0)
+
+    rng = np.random.default_rng(0)
+    # uint8, as a decoder produces them; TPUModel casts on device so the
+    # host->HBM link moves 1 byte/pixel
+    imgs = rng.integers(0, 256, size=(N_IMAGES, 32, 32, 3), dtype=np.uint8)
+    table = DataTable({"image": imgs})
+
+    model = TPUModel(bundle, inputCol="image", outputCol="scores",
+                     miniBatchSize=BATCH)
+
+    # warmup: compile + first transfer
+    model.transform(table.take(BATCH))
+
+    t0 = time.perf_counter()
+    out = model.transform(table)
+    elapsed = time.perf_counter() - t0
+    assert out["scores"].shape == (N_IMAGES, 10)
+
+    import jax
+    images_per_sec = N_IMAGES / elapsed / len(jax.devices())
+    print(json.dumps({
+        "metric": "cifar10_convnet_score_images_per_sec_per_chip",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / TARGET_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+from mmlspark_tpu.models.definitions import (
+    MODEL_REGISTRY,
+    ConvNetCIFAR10,
+    LinearModel,
+    MLPClassifier,
+    ResNet,
+    build_model,
+)
+from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
+from mmlspark_tpu.models.tpu_model import TPUModel

@@ -1,0 +1,193 @@
+"""TPUModel: distributed DNN scoring as a pipeline Transformer.
+
+The centerpiece replacement for the reference's CNTKModel
+(CNTKModel.scala:174-228): where the reference broadcasts model bytes to
+Spark executors and runs a per-partition JNI minibatch loop with four
+JVM<->C++ copies per batch (applyModel, CNTKModel.scala:29-105), TPUModel
+compiles the forward function once with `jit`, replicates weights into HBM
+across a device mesh, and streams zero-padded fixed-shape minibatches through
+it — each device computing its shard of the batch, with XLA handling layout
+and (on multi-chip meshes) ICI transfers.
+
+Node selection (`outputNodeName` / `outputNodeIndex`, reference
+CNTKModel.scala:151-168, 185-193) resolves against the module's sown named
+nodes at trace time; unused heads are dead-code-eliminated by XLA, so scoring
+an early layer (ImageFeaturizer's layer cutting) costs only the truncated
+graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
+from mmlspark_tpu.parallel.bridge import pad_to_multiple, replicate_tree
+from mmlspark_tpu.parallel.mesh import batch_sharding, best_mesh, replicated
+
+
+class TPUModel(Transformer):
+    """Score a table column through a compiled model over the device mesh."""
+
+    inputCol = Param(None, "input column (numeric array per row)", ptype=str)
+    outputCol = Param("output", "output column for scores", ptype=str)
+    miniBatchSize = Param(
+        256, "rows per compiled step; last batch is zero-padded "
+        "(reference default was 10, CNTKModel.scala:164-168 — TPU batches "
+        "are wide to keep the MXU fed)", ptype=int,
+        validator=lambda v: v > 0)
+    outputNodeName = Param(None, "named node to output (None = final)", ptype=str)
+    outputNodeIndex = Param(None, "index into the ordered named nodes", ptype=int)
+
+    def __init__(self, bundle: Optional[ModelBundle] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._bundle = bundle
+        self._mesh = None
+        self._device_vars: dict[int, Any] = {}   # per-mesh replicated weights
+        self._compiled: dict[tuple, Any] = {}    # per-(mesh, node) apply fns
+
+    # -- model/mesh wiring ---------------------------------------------
+    def set_bundle(self, bundle: ModelBundle) -> "TPUModel":
+        self._bundle = bundle
+        self._device_vars.clear()
+        self._compiled.clear()
+        return self
+
+    @property
+    def bundle(self) -> Optional[ModelBundle]:
+        return self._bundle
+
+    def set_mesh(self, mesh) -> "TPUModel":
+        self._mesh = mesh
+        self._device_vars.clear()
+        self._compiled.clear()
+        return self
+
+    def _get_mesh(self):
+        if self._mesh is None:
+            self._mesh = best_mesh()
+        return self._mesh
+
+    # -- forward construction ------------------------------------------
+    def _select_output(self, final, intermediates: dict):
+        name = self.outputNodeName
+        idx = self.outputNodeIndex
+        nodes = {k: v[0] if isinstance(v, tuple) else v
+                 for k, v in intermediates.items()}
+        if name is not None:
+            if name not in nodes:
+                raise KeyError(
+                    f"model has no node '{name}'; nodes: {list(nodes)}")
+            return nodes[name]
+        if idx is not None:
+            keys = list(nodes)
+            if idx >= len(keys):
+                raise IndexError(
+                    f"outputNodeIndex {idx} out of range; nodes: {keys}")
+            return nodes[keys[idx]]
+        return final
+
+    def _make_apply(self, mesh, variables):
+        module = self._bundle.module()
+
+        def forward(vars_, x):
+            # integer inputs (uint8 images) travel the host->HBM link at 1/4
+            # the bytes of float32 and are cast on device — the transfer link
+            # is the scoring bottleneck, not the MXU
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(jnp.float32)
+            out, state = module.apply(vars_, x, mutable=["intermediates"])
+            inter = state.get("intermediates", {})
+            inter = {k: v for k, v in inter.items() if not isinstance(v, dict)}
+            return self._select_output(out, inter)
+
+        return jax.jit(
+            forward,
+            in_shardings=(replicated(mesh), batch_sharding(mesh)),
+            out_shardings=batch_sharding(mesh),
+        )
+
+    def _device_state(self):
+        """Mesh, replicated variables, and the compiled step (cached).
+
+        Weights are replicated once per mesh; node selections share them
+        (only the compiled apply differs per node).
+        """
+        if self._bundle is None:
+            raise ValueError("TPUModel has no model bundle; call set_bundle()")
+        mesh = self._get_mesh()
+        if id(mesh) not in self._device_vars:
+            self._device_vars[id(mesh)] = replicate_tree(
+                self._bundle.variables, mesh)
+        variables = self._device_vars[id(mesh)]
+        key = (id(mesh), self.outputNodeName, self.outputNodeIndex)
+        if key not in self._compiled:
+            self._compiled[key] = self._make_apply(mesh, variables)
+        return mesh, variables, self._compiled[key]
+
+    # -- transform ------------------------------------------------------
+    def transform(self, table: DataTable) -> DataTable:
+        self._check_required()
+        in_col = self.inputCol
+        if in_col is None:
+            raise ValueError("TPUModel: inputCol is not set")
+        col = table[in_col]
+        if col.dtype == object:
+            col = (np.stack([np.asarray(v, np.float32) for v in col])
+                   if len(col) else np.zeros((0, 1), np.float32))
+        mesh, variables, apply_fn = self._device_state()
+        bs = self.miniBatchSize
+        n_data = mesh.shape["data"]
+        bs = max(bs, n_data) - (max(bs, n_data) % n_data) or n_data
+        sharding = batch_sharding(mesh)
+
+        # Pipelined dispatch: enqueue transfer+compute for a window of
+        # batches before fetching, so host->device transfers overlap with
+        # device compute (the reference's JNI loop was fully synchronous
+        # per batch, CNTKModel.scala:63-92).
+        window = 8
+        n = len(col)
+        in_flight: list[tuple[Any, int]] = []
+        results: list[np.ndarray] = []
+
+        def drain(count: int):
+            while len(in_flight) > count:
+                out, valid = in_flight.pop(0)
+                results.append(np.asarray(jax.device_get(out))[:valid])
+
+        for start in range(0, n, bs):
+            chunk, valid = pad_to_multiple(col[start:start + bs], bs)
+            dev = jax.device_put(chunk, sharding)
+            in_flight.append((apply_fn(variables, dev), valid))
+            drain(window)
+        drain(0)
+        if results:
+            result = np.concatenate(results, axis=0)
+        else:
+            # preserve the model's output shape for zero-row tables
+            var_shapes = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), variables)
+            out_shape = jax.eval_shape(
+                apply_fn, var_shapes,
+                jax.ShapeDtypeStruct((bs,) + col.shape[1:], col.dtype))
+            result = np.zeros((0,) + out_shape.shape[1:], out_shape.dtype)
+        return table.with_column(self.outputCol, result)
+
+    # -- persistence ----------------------------------------------------
+    def _save_extra(self, path: str) -> None:
+        if self._bundle is not None:
+            save_bundle(self._bundle, f"{path}/bundle")
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        self._bundle = (load_bundle(f"{path}/bundle")
+                        if os.path.exists(f"{path}/bundle") else None)
+        self._mesh = None
+        self._device_vars = {}
+        self._compiled = {}

@@ -1,0 +1,14 @@
+from mmlspark_tpu.parallel.mesh import (
+    MeshSpec,
+    batch_sharding,
+    best_mesh,
+    make_mesh,
+    replicated,
+)
+from mmlspark_tpu.parallel.bridge import (
+    device_to_host,
+    pad_to_multiple,
+    shard_batch,
+    shard_table_columns,
+)
+from mmlspark_tpu.parallel.distributed import DistributedConfig, initialize_distributed

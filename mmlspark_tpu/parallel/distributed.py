@@ -1,0 +1,84 @@
+"""Multi-host initialization and collective helpers.
+
+Replaces the reference's external-launcher topology — `mpiexec -n <gpus> cntk
+parallelTrain=true` plus a hand-written hostfile
+(CommandBuilders.scala:79-117) — with in-process `jax.distributed`: every host
+runs the same program, `initialize_distributed` wires the DCN rendezvous, and
+all collectives are XLA ops over ICI (intra-slice) / DCN (inter-slice).
+There is no separate launcher binary to build: any process manager (GKE,
+xmanager, bash over ssh) that starts N identical processes works.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    """Rendezvous config for multi-host (multi-slice) runs.
+
+    Field defaults read the standard JAX env vars so a bare
+    `initialize_distributed()` works under any cluster manager that sets
+    them; explicit values win (the reference's analogue was the hard-coded
+    hostfile at CommandBuilders.scala:95-117 — deliberately more flexible
+    here).
+    """
+
+    coordinator_address: Optional[str] = None   # "host:port" of process 0
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    @staticmethod
+    def from_env() -> "DistributedConfig":
+        return DistributedConfig(
+            coordinator_address=os.environ.get("MMLSPARK_TPU_COORDINATOR"),
+            num_processes=_int_env("MMLSPARK_TPU_NUM_PROCESSES"),
+            process_id=_int_env("MMLSPARK_TPU_PROCESS_ID"),
+        )
+
+
+def _int_env(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+_initialized = False
+
+
+def initialize_distributed(config: Optional[DistributedConfig] = None) -> bool:
+    """Initialize jax.distributed if a multi-host config is present.
+
+    Returns True when running multi-host, False for single-process (the
+    common local / single-slice case, where initialization is unnecessary).
+    Safe to call more than once.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    cfg = config or DistributedConfig.from_env()
+    if cfg.coordinator_address is None and cfg.num_processes is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _initialized = True
+    return True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0

@@ -1,0 +1,97 @@
+"""Device mesh construction and sharding specs.
+
+The TPU-native replacement for the reference's process-level parallelism:
+Spark partitions for scoring (CNTKModel.scala:215-221) and the `mpiexec` MPI
+ring for training (CommandBuilders.scala:79-117) both collapse into one
+abstraction — a `jax.sharding.Mesh` over the slice's chips, with XLA inserting
+collectives over ICI (and DCN across slices).  Standard axis names:
+
+    data   - data parallelism (batch axis)         [replaces Spark partitions / MPI ranks]
+    model  - tensor/model parallelism               (new-design headroom)
+    seq    - sequence/context parallelism           (new-design headroom)
+
+The reference detected parallel width with `nvidia-smi -L`
+(EnvironmentUtils.scala:20-50); here width is `jax.device_count()`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape; -1 means "all remaining devices"."""
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: Optional[int] = None) -> dict[str, int]:
+        n = n_devices if n_devices is not None else jax.device_count()
+        sizes = {"data": self.data, "model": self.model, "seq": self.seq}
+        fixed = int(np.prod([s for s in sizes.values() if s > 0]))
+        free = [k for k, s in sizes.items() if s <= 0]
+        if len(free) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {free}")
+        if free:
+            if n % fixed:
+                raise ValueError(
+                    f"{n} devices not divisible by fixed axes product {fixed}")
+            sizes[free[0]] = n // fixed
+        total = int(np.prod(list(sizes.values())))
+        if total != n:
+            raise ValueError(f"mesh {sizes} wants {total} devices, have {n}")
+        return sizes
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    Axes with size 1 are kept so shardings can always name them — XLA
+    elides trivial collectives, so this costs nothing.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(len(devices))
+    axis_names = tuple(sizes)
+    dev_array = np.asarray(devices).reshape(tuple(sizes.values()))
+    return Mesh(dev_array, axis_names)
+
+
+def best_mesh(n_data: Optional[int] = None) -> Mesh:
+    """The default 1-D data-parallel mesh (the CNTKModel scoring topology)."""
+    if n_data is None:
+        return make_mesh(MeshSpec())
+    devices = jax.devices()[:n_data]
+    return make_mesh(MeshSpec(data=n_data), devices)
+
+
+def batch_sharding(mesh: Mesh, *, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for a batch: leading axis split over `axis`, rest replicated."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding — model weights under pure data parallelism.
+
+    Replaces the reference's model-bytes broadcast (CNTKModel.scala:215):
+    weights live replicated in HBM instead of being re-deserialized per
+    partition.
+    """
+    return NamedSharding(mesh, P())
+
+
+def model_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    """Arbitrary weight sharding for tensor-parallel layouts."""
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,3 @@
+from mmlspark_tpu.train.config import TrainerConfig
+from mmlspark_tpu.train.trainer import Trainer, TrainState
+from mmlspark_tpu.train.learner import TPULearner

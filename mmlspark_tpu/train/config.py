@@ -1,0 +1,82 @@
+"""TrainerConfig: the typed replacement for BrainScript.
+
+The reference configures CNTK training by generating BrainScript text files
+(BrainscriptBuilder.scala:94-115) and shelling out to `cntk` under `mpiexec`
+(CommandBuilders.scala:60-93).  Here training is in-process: a plain typed
+config drives an optax/jit training loop, and "parallelTrain=true" becomes a
+mesh spec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+from mmlspark_tpu.parallel.mesh import MeshSpec
+
+LOSSES = ("softmax_xent", "sigmoid_xent", "mse", "mae")
+OPTIMIZERS = ("sgd", "momentum", "adam", "adamw")
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    # model
+    architecture: str = "MLPClassifier"
+    model_config: dict = dataclasses.field(default_factory=dict)
+
+    # optimization (the BrainScript SGD block equivalent)
+    optimizer: str = "momentum"
+    learning_rate: float = 1e-2
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    lr_schedule: str = "constant"          # constant | cosine | warmup_cosine
+    warmup_steps: int = 0
+    gradient_clip_norm: Optional[float] = None
+
+    # loop
+    loss: str = "softmax_xent"
+    epochs: int = 1
+    batch_size: int = 256
+    seed: int = 0
+    shuffle_each_epoch: bool = True
+
+    # parallelism (replaces `mpiexec -n N` + parallelTrain)
+    mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    # shard dense kernels' last dim over the 'model' axis when it divides
+    # evenly (simple tensor parallelism; data parallelism is always on)
+    tensor_parallel: bool = True
+
+    # checkpoint/resume (the reference had none, SURVEY section 5)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_steps: int = 0        # 0 = only at end
+
+    def __post_init__(self):
+        if self.loss not in LOSSES:
+            raise ValueError(f"loss must be one of {LOSSES}, got {self.loss!r}")
+        if self.optimizer not in OPTIMIZERS:
+            raise ValueError(
+                f"optimizer must be one of {OPTIMIZERS}, got {self.optimizer!r}")
+        if isinstance(self.mesh, dict):
+            self.mesh = MeshSpec(**self.mesh)
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["mesh"] = dataclasses.asdict(self.mesh)
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "TrainerConfig":
+        d = dict(d)
+        if "mesh" in d:
+            d["mesh"] = MeshSpec(**d["mesh"])
+        return TrainerConfig(**d)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1)
+
+    @staticmethod
+    def load(path: str) -> "TrainerConfig":
+        with open(path) as f:
+            return TrainerConfig.from_json(json.load(f))

@@ -1,0 +1,88 @@
+"""TPULearner: the Estimator face of distributed training.
+
+Replacement for the reference's CNTKLearner (CNTKLearner.scala:52-162): the
+same pipeline contract — `fit(table with features+label) -> scoring model` —
+but instead of exporting data to CNTKText files and shelling out to
+`cntk`/`mpiexec`, it trains in-process on the mesh and wraps the result as a
+TPUModel, exactly as CNTKLearner wraps its output `.model` file as a
+CNTKModel (CNTKLearner.scala:158-161).  Fine-tuning a zoo model = setting
+`initial_bundle` (the localHdfsMount/model-download dance collapses away).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Estimator
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
+from mmlspark_tpu.models.tpu_model import TPUModel
+from mmlspark_tpu.train.config import TrainerConfig
+from mmlspark_tpu.train.trainer import Trainer
+
+
+class TPULearner(Estimator):
+    featuresCol = Param("features", "features column", ptype=str)
+    labelCol = Param("label", "label column", ptype=str)
+    outputCol = Param("output", "output column of the fitted model", ptype=str)
+    config = Param(None, "TrainerConfig as a JSON dict", ptype=dict)
+    logEvery = Param(50, "epoch logging interval", ptype=int)
+
+    def __init__(self, config: Optional[TrainerConfig] = None, **kwargs):
+        super().__init__(**kwargs)
+        if config is not None:
+            self.set("config", config.to_json())
+        self._initial_bundle: Optional[ModelBundle] = None
+        self._mesh = None
+
+    def trainer_config(self) -> TrainerConfig:
+        return TrainerConfig.from_json(self.config) if self.config \
+            else TrainerConfig()
+
+    def set_initial_bundle(self, bundle: ModelBundle) -> "TPULearner":
+        """Warm-start weights (transfer learning / fine-tune flow)."""
+        self._initial_bundle = bundle
+        return self
+
+    def set_mesh(self, mesh) -> "TPULearner":
+        self._mesh = mesh
+        return self
+
+    def fit(self, table: DataTable) -> TPUModel:
+        cfg = self.trainer_config()
+        # drop rows with missing labels (reference CNTKLearner.scala:58)
+        clean = table.drop_nulls([self.labelCol])
+        x = np.asarray(clean[self.featuresCol], np.float32)
+        y = np.asarray(clean[self.labelCol])
+        if y.dtype == object:
+            raise TypeError(
+                f"label column '{self.labelCol}' must be numeric; "
+                "encode categoricals first (see core.schema.make_categorical)")
+        trainer = Trainer(cfg, mesh=self._mesh)
+        bundle = trainer.fit_arrays(
+            x, y, initial_bundle=self._initial_bundle,
+            log_every=self.logEvery, log_fn=_log)
+        model = TPUModel(bundle, inputCol=self.featuresCol,
+                         outputCol=self.outputCol,
+                         miniBatchSize=max(cfg.batch_size, 1))
+        model._history = list(trainer.history)
+        return model
+
+    def _save_extra(self, path: str) -> None:
+        if self._initial_bundle is not None:
+            save_bundle(self._initial_bundle, f"{path}/initial_bundle")
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        self._initial_bundle = (load_bundle(f"{path}/initial_bundle")
+                                if os.path.exists(f"{path}/initial_bundle")
+                                else None)
+        self._mesh = None
+
+
+def _log(msg: str) -> None:
+    import logging
+    logging.getLogger("mmlspark_tpu.train").info(msg)

@@ -7,12 +7,19 @@ SparkSessionFactory.scala:40-51 — all "distributed" tests single-host).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The environment's sitecustomize may import jax at interpreter startup
+# (registering a TPU PJRT plugin), which makes env vars alone too late;
+# jax.config can still flip the platform before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -5,11 +5,13 @@ transfer, jit forward, fetch — i.e. the replacement for the reference's
 CNTKModel per-partition JNI scoring loop (CNTKModel.scala:50-104, the
 notebook-301 workload).
 
-Baseline arithmetic (BASELINE.json north_star): beat 4x the 4xK80 Azure
-N-series CNTK path.  The reference publishes no throughput number; we take
-~1000 img/s per K80 for this ConvNet class (typical CNTK-era measurement),
-so 4 chips ~= 4000 img/s and the 4x target is 16000 img/s.  vs_baseline
-reported here is measured / 16000.
+Baseline arithmetic (BASELINE.json north_star): a v5e-8 slice should beat
+4x the 4xK80 Azure N-series CNTK path.  The reference publishes no
+throughput number; we take ~1000 img/s per K80 for this ConvNet class
+(typical CNTK-era measurement), so 4 GPUs ~= 4000 img/s and the 4x target
+is 16000 img/s for the 8-chip slice — i.e. 2000 img/s per chip.  The
+metric here is per-chip so it is comparable whatever the slice size;
+vs_baseline is measured-per-chip / 2000.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -20,7 +22,7 @@ import time
 
 import numpy as np
 
-TARGET_IMAGES_PER_SEC = 16000.0
+TARGET_IMAGES_PER_SEC_PER_CHIP = 2000.0
 N_IMAGES = 32768
 BATCH = 4096
 
@@ -55,7 +57,7 @@ def main():
         "metric": "cifar10_convnet_score_images_per_sec_per_chip",
         "value": round(images_per_sec, 1),
         "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / TARGET_IMAGES_PER_SEC, 3),
+        "vs_baseline": round(images_per_sec / TARGET_IMAGES_PER_SEC_PER_CHIP, 3),
     }))
 
 

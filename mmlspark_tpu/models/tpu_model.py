@@ -141,6 +141,9 @@ class TPUModel(Transformer):
         if col.dtype == object:
             col = (np.stack([np.asarray(v, np.float32) for v in col])
                    if len(col) else np.zeros((0, 1), np.float32))
+        # CheckpointData may have pre-staged this column in device memory
+        # (stages/basic.py); repeated passes then skip the host->HBM transfer.
+        dev_col = getattr(table, "_device_cache", {}).get(in_col)
         mesh, variables, apply_fn = self._device_state()
         bs = self.miniBatchSize
         n_data = mesh.shape["data"]
@@ -162,8 +165,16 @@ class TPUModel(Transformer):
                 results.append(np.asarray(jax.device_get(out))[:valid])
 
         for start in range(0, n, bs):
-            chunk, valid = pad_to_multiple(col[start:start + bs], bs)
-            dev = jax.device_put(chunk, sharding)
+            if dev_col is not None:
+                chunk = dev_col[start:start + bs]
+                valid = int(chunk.shape[0])
+                if valid < bs:
+                    pad = [(0, bs - valid)] + [(0, 0)] * (chunk.ndim - 1)
+                    chunk = jnp.pad(chunk, pad)
+                dev = jax.device_put(chunk, sharding)  # on-device reshard
+            else:
+                chunk, valid = pad_to_multiple(col[start:start + bs], bs)
+                dev = jax.device_put(chunk, sharding)
             in_flight.append((apply_fn(variables, dev), valid))
             drain(window)
         drain(0)

@@ -1,0 +1,16 @@
+"""Utility pipeline stages (reference L3: pipeline-stages, data-conversion,
+summarize-data, partition-sample, checkpoint-data, multi-column-adapter)."""
+
+from mmlspark_tpu.stages.basic import (CheckpointData, DropColumns,
+                                       RenameColumns, Repartition,
+                                       SelectColumns)
+from mmlspark_tpu.stages.data_conversion import DataConversion
+from mmlspark_tpu.stages.summarize import SummarizeData
+from mmlspark_tpu.stages.sample import PartitionSample
+from mmlspark_tpu.stages.adapter import MultiColumnAdapter
+
+__all__ = [
+    "SelectColumns", "DropColumns", "RenameColumns", "Repartition",
+    "CheckpointData", "DataConversion", "SummarizeData", "PartitionSample",
+    "MultiColumnAdapter",
+]

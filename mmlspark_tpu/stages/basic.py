@@ -1,0 +1,111 @@
+"""Column-selection, resharding and persistence stages.
+
+TPU-native counterparts of the reference's pipeline-stages and
+checkpoint-data components (SelectColumns.scala:22-63, Repartition.scala:15-42,
+CheckpointData.scala:35-69).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.table import DataTable
+
+
+class SelectColumns(Transformer):
+    """Keep only the listed columns (reference SelectColumns.scala:22-63:
+    missing columns are an error, matching Spark's analysis exception)."""
+
+    cols = Param(None, "columns to keep", ptype=(list, tuple), required=True)
+
+    def transform(self, table: DataTable) -> DataTable:
+        self._check_required()
+        missing = [c for c in self.cols if c not in table]
+        if missing:
+            raise KeyError(f"SelectColumns: no such columns {missing}; "
+                           f"available: {table.columns}")
+        return table.select(*self.cols)
+
+
+class DropColumns(Transformer):
+    """Drop the listed columns (the dual convenience stage)."""
+
+    cols = Param(None, "columns to drop", ptype=(list, tuple), required=True)
+
+    def transform(self, table: DataTable) -> DataTable:
+        self._check_required()
+        missing = [c for c in self.cols if c not in table]
+        if missing:
+            raise KeyError(f"DropColumns: no such columns {missing}; "
+                           f"available: {table.columns}")
+        return table.drop(*self.cols)
+
+
+class RenameColumns(Transformer):
+    """Rename columns via a mapping (metadata travels with the column)."""
+
+    mapping = Param(None, "old-name -> new-name mapping", ptype=dict,
+                    required=True)
+
+    def transform(self, table: DataTable) -> DataTable:
+        self._check_required()
+        missing = [c for c in self.mapping if c not in table]
+        if missing:
+            raise KeyError(f"RenameColumns: no such columns {missing}")
+        return table.rename(self.mapping)
+
+
+class Repartition(Transformer):
+    """Set the table's shard count — the layout hint the parallel layer uses
+    when placing batches on the mesh.
+
+    Reference Repartition.scala:15-42: `n` partitions with a
+    `disable`/coalesce-vs-shuffle switch.  On TPU "partitions" are mesh
+    shards; there is no shuffle cost distinction (resharding happens at the
+    device boundary), so only the count survives.
+    """
+
+    n = Param(None, "number of shards", ptype=int, required=True,
+              validator=lambda v: v > 0)
+    disable = Param(False, "pass the table through unchanged", ptype=bool)
+
+    def transform(self, table: DataTable) -> DataTable:
+        self._check_required()
+        if self.disable:
+            return table
+        return table.repartition(self.n)
+
+
+class CheckpointData(Transformer):
+    """Materialize (or release) table columns in device HBM.
+
+    Reference CheckpointData.scala:35-69 caches/unpersists a DataFrame in
+    executor memory as a pipeline stage.  The TPU equivalent of "cache" is
+    pre-staging numeric columns into device memory so downstream scoring
+    stages skip the host->HBM transfer on every pass over the table (e.g.
+    FindBestModel scoring many models on one eval set); "unpersist"
+    (removeCheckpoint=True) drops those buffers.  The cache lives on the
+    table object itself, so it is garbage-collected with the table;
+    TPUModel consults it via `get_device_cache`.
+    """
+
+    removeCheckpoint = Param(False, "release instead of persist", ptype=bool)
+
+    def transform(self, table: DataTable) -> DataTable:
+        import jax
+        if self.removeCheckpoint:
+            table.__dict__.pop("_device_cache", None)
+            return table
+        cache: dict[str, object] = {}
+        for name in table.columns:
+            arr = table[name]
+            if arr.dtype != object and np.issubdtype(arr.dtype, np.number):
+                cache[name] = jax.device_put(np.ascontiguousarray(arr))
+        table.__dict__["_device_cache"] = cache
+        return table
+
+    @staticmethod
+    def get_device_cache(table: DataTable) -> dict[str, object]:
+        return getattr(table, "_device_cache", {})

@@ -13,7 +13,11 @@ jit-compiled train step.  Parallelism is declarative:
 
 Padding rows in the final minibatch are masked out of the loss — the
 reference instead zero-padded and let garbage rows into the batch
-(CNTKModel.scala:71-76); masking keeps gradients exact.
+(CNTKModel.scala:71-76); masking keeps loss gradients exact.  Pad rows are
+filled by cycling real rows (never zeros) so stateful normalization layers
+(BatchNorm) compute their batch statistics over real data; a partial final
+batch therefore sees some rows duplicated in the statistics, which is the
+standard drop-nothing tradeoff.
 """
 
 from __future__ import annotations
@@ -33,7 +37,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mmlspark_tpu.models.bundle import ModelBundle, _to_plain
 from mmlspark_tpu.models.definitions import build_model
-from mmlspark_tpu.parallel.bridge import pad_to_multiple
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh, replicated
 from mmlspark_tpu.train.config import TrainerConfig
 
@@ -203,25 +206,29 @@ class Trainer:
 
         rng = np.random.default_rng(cfg.seed)
         t0 = time.perf_counter()
+        step = 0  # host-side counter; never sync on state.step mid-epoch
         for epoch in range(cfg.epochs):
             order = rng.permutation(n) if cfg.shuffle_each_epoch else np.arange(n)
-            epoch_loss, n_batches = 0.0, 0
+            losses: list = []
             for start in range(0, n, bs):
                 idx = order[start:start + bs]
-                xb, valid = pad_to_multiple(x[idx], bs)
-                yb, _ = pad_to_multiple(y[idx], bs)
+                valid = len(idx)
+                if valid < bs:
+                    # cycle real rows into the pad (see module docstring)
+                    idx = np.concatenate([idx, np.resize(order, bs - valid)])
                 mask = np.zeros(bs, np.float32)
                 mask[:valid] = 1.0
-                xb = jax.device_put(xb, x_sh)
-                yb = jax.device_put(yb, x_sh)
+                xb = jax.device_put(x[idx], x_sh)
+                yb = jax.device_put(y[idx], x_sh)
                 mask_d = jax.device_put(mask, x_sh)
                 state, loss = step_fn(state, xb, yb, mask_d)
-                epoch_loss += float(loss)
-                n_batches += 1
-                step = int(state.step)
+                losses.append(loss)  # device array; fetched at epoch end
+                step += 1
                 if cfg.checkpoint_dir and cfg.checkpoint_every_steps and \
                         step % cfg.checkpoint_every_steps == 0:
                     self.save_checkpoint(state, cfg.checkpoint_dir)
+            n_batches = len(losses)
+            epoch_loss = float(np.sum(jax.device_get(losses)))
             rec = {"epoch": epoch, "loss": epoch_loss / max(n_batches, 1),
                    "wall_s": time.perf_counter() - t0}
             self.history.append(rec)

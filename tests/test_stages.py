@@ -1,0 +1,210 @@
+"""Utility pipeline stage tests (reference L3 components)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataTable
+from mmlspark_tpu.core.params import ParamError
+from mmlspark_tpu.core.pipeline import load_stage
+from mmlspark_tpu.core.schema import make_categorical
+from mmlspark_tpu.stages import (
+    CheckpointData,
+    DataConversion,
+    DropColumns,
+    MultiColumnAdapter,
+    PartitionSample,
+    RenameColumns,
+    Repartition,
+    SelectColumns,
+    SummarizeData,
+)
+
+
+@pytest.fixture
+def table():
+    return DataTable({
+        "a": np.arange(10, dtype=np.float64),
+        "b": np.arange(10, dtype=np.int64) * 2,
+        "s": [f"v{i % 3}" for i in range(10)],
+    })
+
+
+# ------------------------------------------------------------- selection ---
+
+def test_select_columns(table):
+    out = SelectColumns(cols=["a", "s"]).transform(table)
+    assert out.columns == ["a", "s"]
+
+
+def test_select_missing_raises(table):
+    with pytest.raises(KeyError):
+        SelectColumns(cols=["a", "zz"]).transform(table)
+
+
+def test_drop_columns(table):
+    out = DropColumns(cols=["b"]).transform(table)
+    assert out.columns == ["a", "s"]
+
+
+def test_rename_columns_keeps_meta(table):
+    t = make_categorical(table, "s")
+    out = RenameColumns(mapping={"s": "cat"}).transform(t)
+    assert "cat" in out.columns and out.meta("cat").is_categorical
+
+
+def test_repartition(table):
+    out = Repartition(n=4).transform(table)
+    assert out.num_shards == 4
+    assert Repartition(n=4, disable=True).transform(table).num_shards == 1
+
+
+def test_checkpoint_device_cache(table):
+    stage = CheckpointData()
+    out = stage.transform(table)
+    cache = CheckpointData.get_device_cache(out)
+    assert set(cache) == {"a", "b"}
+    released = CheckpointData(removeCheckpoint=True).transform(out)
+    assert CheckpointData.get_device_cache(released) == {}
+
+
+# ------------------------------------------------------- data conversion ---
+
+def test_numeric_conversions(table):
+    out = DataConversion(cols=["a", "b"], convertTo="float").transform(table)
+    assert out["a"].dtype == np.float32 and out["b"].dtype == np.float32
+    out = DataConversion(cols="a, b", convertTo="integer").transform(table)
+    assert out["a"].dtype == np.int32
+
+
+def test_string_conversion(table):
+    out = DataConversion(cols=["b"], convertTo="string").transform(table)
+    assert out["b"][3] == "6"
+
+
+def test_to_categorical_round_trip(table):
+    enc = DataConversion(cols=["s"], convertTo="toCategorical").transform(table)
+    assert enc.meta("s").is_categorical
+    assert enc["s"].dtype == np.int32
+    dec = DataConversion(cols=["s"], convertTo="clearCategorical").transform(enc)
+    assert not dec.meta("s").is_categorical
+    assert list(dec["s"]) == list(table["s"])
+
+
+def test_date_conversions():
+    t = DataTable({"d": ["2017-09-01 10:00:00", "2017-09-02 11:30:00"]})
+    dated = DataConversion(cols=["d"], convertTo="date").transform(t)
+    assert np.issubdtype(dated["d"].dtype, np.datetime64)
+    as_long = DataConversion(cols=["d"], convertTo="long").transform(dated)
+    assert np.issubdtype(as_long["d"].dtype, np.integer)
+    back = DataConversion(cols=["d"], convertTo="date").transform(as_long)
+    assert (back["d"] == dated["d"]).all()
+    s = DataConversion(cols=["d"], convertTo="string").transform(dated)
+    assert s["d"][0] == "2017-09-01 10:00:00"
+
+
+def test_string_to_boolean_rejected():
+    t = DataTable({"x": ["true", "false"]})
+    with pytest.raises(TypeError):
+        DataConversion(cols=["x"], convertTo="boolean").transform(t)
+
+
+# ------------------------------------------------------------- summarize ---
+
+def test_summarize_all_groups(table):
+    out = SummarizeData().transform(table)
+    assert list(out["Feature"]) == ["a", "b", "s"]
+    a = {f: out[f][0] for f in out.columns}
+    assert a["Count"] == 10 and a["Missing Value Count"] == 0
+    assert a["Min"] == 0.0 and a["Max"] == 9.0 and a["Median"] == 4.5
+    assert a["Sample Variance"] == pytest.approx(np.var(np.arange(10), ddof=1))
+    # string column gets NaN numeric stats but real counts
+    s = {f: out[f][2] for f in out.columns}
+    assert s["Unique Value Count"] == 3 and np.isnan(s["Min"])
+
+
+def test_summarize_group_toggles(table):
+    out = SummarizeData(basic=False, sample=False,
+                        percentiles=False).transform(table)
+    assert set(out.columns) == {"Feature", "Count", "Unique Value Count",
+                                "Missing Value Count"}
+
+
+def test_summarize_missing_counted():
+    t = DataTable({"x": np.array([1.0, np.nan, 3.0])})
+    out = SummarizeData().transform(t)
+    assert out["Missing Value Count"][0] == 1 and out["Count"][0] == 2
+
+
+# ---------------------------------------------------------------- sample ---
+
+def test_partition_sample_head(table):
+    assert PartitionSample(mode="Head", count=3).transform(table).num_rows == 3
+
+
+def test_partition_sample_percentage(table):
+    out = PartitionSample(mode="RandomSample", percent=0.5,
+                          seed=7).transform(table)
+    assert 0 < out.num_rows < 10
+
+
+def test_partition_sample_atp(table):
+    out = PartitionSample(mode="AssignToPartition", numParts=4,
+                          seed=3).transform(table)
+    parts = out["Partition"]
+    assert parts.dtype == np.int32
+    assert ((parts >= 0) & (parts < 4)).all()
+
+
+# --------------------------------------------------------------- adapter ---
+
+def test_multi_column_adapter_transform(table):
+    from mmlspark_tpu.core.params import Param
+    from mmlspark_tpu.core.pipeline import Transformer
+
+    class Scaler(Transformer):
+        inputCol = Param(None, "in", ptype=str)
+        outputCol = Param(None, "out", ptype=str)
+        factor = Param(2.0, "scale", ptype=float)
+
+        def transform(self, t):
+            return t.with_column(self.outputCol, t[self.inputCol] * self.factor)
+
+    adapter = MultiColumnAdapter(Scaler(factor=3.0),
+                                 inputCols=["a", "b"],
+                                 outputCols=["a3", "b3"])
+    out = adapter.transform(table)
+    assert (out["a3"] == table["a"] * 3).all()
+    assert (out["b3"] == table["b"] * 3).all()
+    model = adapter.fit(table)
+    out2 = model.transform(table)
+    assert (out2["b3"] == table["b"] * 3).all()
+
+
+def test_multi_column_adapter_mismatch(table):
+    from mmlspark_tpu.core.params import Param
+    from mmlspark_tpu.core.pipeline import Transformer
+
+    class Ident(Transformer):
+        inputCol = Param(None, "in", ptype=str)
+        outputCol = Param(None, "out", ptype=str)
+
+        def transform(self, t):
+            return t.with_column(self.outputCol, t[self.inputCol])
+
+    with pytest.raises(ParamError):
+        MultiColumnAdapter(Ident(), inputCols=["a"],
+                           outputCols=["x", "y"]).transform(table)
+
+
+# ------------------------------------------------------------ persistence ---
+
+def test_stage_save_load_round_trip(tmp_path, table):
+    stage = DataConversion(cols=["a"], convertTo="integer")
+    stage.save(str(tmp_path / "dc"))
+    loaded = load_stage(str(tmp_path / "dc"))
+    out = loaded.transform(table)
+    assert out["a"].dtype == np.int32
+
+    samp = PartitionSample(mode="Head", count=2)
+    samp.save(str(tmp_path / "ps"))
+    assert load_stage(str(tmp_path / "ps")).transform(table).num_rows == 2

@@ -1,0 +1,19 @@
+"""Featurization layer (reference L4: featurize/, text-featurizer/)."""
+
+from mmlspark_tpu.feature.assemble import AssembleFeatures, AssembleFeaturesModel, Featurize
+from mmlspark_tpu.feature.text import (
+    HashingTF,
+    IDF,
+    IDFModel,
+    NGram,
+    StopWordsRemover,
+    TextFeaturizer,
+    Tokenizer,
+)
+from mmlspark_tpu.feature.hashing import densify_sparse_column, stable_hash
+
+__all__ = [
+    "AssembleFeatures", "AssembleFeaturesModel", "Featurize",
+    "Tokenizer", "StopWordsRemover", "NGram", "HashingTF", "IDF", "IDFModel",
+    "TextFeaturizer", "stable_hash", "densify_sparse_column",
+]

@@ -1,0 +1,256 @@
+"""Auto-featurization: AssembleFeatures / Featurize.
+
+TPU-native counterpart of the reference's featurize component
+(AssembleFeatures.scala:133-390, Featurize.scala:67-82): per-column type
+dispatch, string hashing with count-based slot selection, one-hot encoding
+of categoricals, missing-value handling, and assembly — except the output
+is a dense float32 matrix column ready for device transfer instead of a
+Spark SparseVector, and the per-row UDF loops become batched numpy ops.
+
+Block order mirrors FastVectorAssembler's categoricals-first rule
+(FastVectorAssembler.scala:50): categorical blocks, then numeric/vector
+blocks in input order, then the hash-selected string block last.  The block
+plan is recorded in the output column's metadata so learners can recover
+slot names, categorical slot ranges, and the total width (the MLP
+input-autosizing information, TrainClassifier.scala:143-150).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Estimator, Pipeline, PipelineModel, Transformer
+from mmlspark_tpu.core.schema import ColumnMeta
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.feature.hashing import (densify_sparse_column,
+                                          nonzero_slots, sparse_count_row)
+
+
+def _object_rows(rows: list) -> np.ndarray:
+    out = np.empty(len(rows), dtype=object)
+    out[:] = rows
+    return out
+
+# 2^18 slots by default; 2^12 for tree/NN learners (Featurize.scala:13-19)
+NUM_FEATURES_DEFAULT = 1 << 18
+NUM_FEATURES_TREE_OR_NN = 1 << 12
+
+
+def _tokenize_strings(values) -> list[str]:
+    """Lowercase whitespace tokenization over one row's string cells
+    (reference hashStringColumns, AssembleFeatures.scala:46-53)."""
+    toks: list[str] = []
+    for v in values:
+        if v is None or v == "":
+            continue
+        toks.extend(str(v).lower().split())
+    return toks
+
+
+class AssembleFeatures(Estimator):
+    """Fit the per-column featurization plan on a table."""
+
+    columnsToFeaturize = Param(None, "columns to featurize",
+                               ptype=(list, tuple), required=True)
+    featuresCol = Param("features", "assembled output column", ptype=str)
+    numberOfFeatures = Param(NUM_FEATURES_DEFAULT,
+                             "hash space for string columns", ptype=int)
+    oneHotEncodeCategoricals = Param(True, "one-hot encode categoricals",
+                                     ptype=bool)
+
+    def fit(self, table: DataTable) -> "AssembleFeaturesModel":
+        self._check_required()
+        cat_blocks: list[dict] = []
+        num_blocks: list[dict] = []
+        hash_cols: list[str] = []
+        clean_cols: list[str] = []
+
+        for col in self.columnsToFeaturize:
+            arr = table[col]
+            meta = table.meta(col)
+            if meta.is_categorical:
+                cat_blocks.append({
+                    "col": col, "kind": "categorical",
+                    "num_levels": meta.categorical.num_levels,
+                    "ohe": bool(self.oneHotEncodeCategoricals),
+                })
+                continue
+            if arr.dtype == object:
+                if any(isinstance(v, str) for v in arr if v is not None):
+                    hash_cols.append(col)
+                else:  # numeric-list rows must form a rectangular block
+                    widths = {len(np.asarray(v).ravel()) for v in arr
+                              if v is not None}
+                    if len(widths) > 1:
+                        raise ValueError(
+                            f"column '{col}' has ragged numeric rows "
+                            f"(widths {sorted(widths)}); pad or split it "
+                            "before featurizing")
+                    num_blocks.append({"col": col, "kind": "vector",
+                                       "width": widths.pop() if widths else 0})
+                    clean_cols.append(col)
+                continue
+            if arr.ndim > 1:
+                num_blocks.append({"col": col, "kind": "vector",
+                                   "width": int(np.prod(arr.shape[1:]))})
+                clean_cols.append(col)
+            elif np.issubdtype(arr.dtype, np.floating):
+                num_blocks.append({"col": col, "kind": "numeric", "width": 1})
+                clean_cols.append(col)
+            elif np.issubdtype(arr.dtype, np.number) or arr.dtype == np.bool_:
+                num_blocks.append({"col": col, "kind": "numeric", "width": 1})
+            elif np.issubdtype(arr.dtype, np.str_):
+                hash_cols.append(col)
+
+        selected = None
+        if hash_cols:
+            nf = self.numberOfFeatures
+            rows = (sparse_count_row(
+                        _tokenize_strings([table[c][i] for c in hash_cols]), nf)
+                    for i in range(table.num_rows))
+            selected = nonzero_slots(rows)
+
+        return AssembleFeaturesModel(
+            cat_blocks=cat_blocks, num_blocks=num_blocks,
+            hash_cols=hash_cols, clean_cols=clean_cols,
+            selected_slots=selected,
+            featuresCol=self.featuresCol,
+            numberOfFeatures=self.numberOfFeatures,
+        )
+
+
+class AssembleFeaturesModel(Transformer):
+    """Apply the fitted featurization plan
+    (reference AssembleFeaturesModel.transform, AssembleFeatures.scala:307-390).
+
+    Rows with missing values in float/vector feature columns are dropped,
+    as the reference's na.drop does (line 352).
+    """
+
+    featuresCol = Param("features", "assembled output column", ptype=str)
+    numberOfFeatures = Param(NUM_FEATURES_DEFAULT, "hash space", ptype=int)
+
+    def __init__(self, cat_blocks: Optional[list] = None,
+                 num_blocks: Optional[list] = None,
+                 hash_cols: Optional[list] = None,
+                 clean_cols: Optional[list] = None,
+                 selected_slots: Optional[np.ndarray] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._cat_blocks = list(cat_blocks or [])
+        self._num_blocks = list(num_blocks or [])
+        self._hash_cols = list(hash_cols or [])
+        self._clean_cols = list(clean_cols or [])
+        self._selected = (np.asarray(selected_slots, np.int32)
+                          if selected_slots is not None else None)
+
+    @property
+    def feature_blocks(self) -> list[dict]:
+        """The assembled block plan, categoricals first."""
+        blocks = []
+        for b in self._cat_blocks:
+            width = (b["num_levels"] - 1 if b["ohe"] else 1)
+            blocks.append({**b, "width": max(width, 0)})
+        blocks.extend({**b} for b in self._num_blocks)
+        if self._hash_cols:
+            blocks.append({"col": "+".join(self._hash_cols), "kind": "hashed",
+                           "width": len(self._selected)})
+        return blocks
+
+    @property
+    def num_output_features(self) -> int:
+        return int(sum(b["width"] for b in self.feature_blocks))
+
+    def transform(self, table: DataTable) -> DataTable:
+        for col in self._hash_cols:
+            if table[col].dtype != object and not np.issubdtype(
+                    table[col].dtype, np.str_):
+                raise TypeError(
+                    f"column '{col}' must be string at score time "
+                    "(reference AssembleFeatures.scala:314)")
+        kept = table.drop_nulls(self._clean_cols) if self._clean_cols else table
+        n = kept.num_rows
+        parts: list[np.ndarray] = []
+
+        for b in self._cat_blocks:
+            idx = np.asarray(kept[b["col"]], np.int64)
+            if b["ohe"]:
+                # Spark OneHotEncoder dropLast: last level encodes as zeros
+                width = max(b["num_levels"] - 1, 0)
+                block = np.zeros((n, width), np.float32)
+                ok = (idx >= 0) & (idx < width)
+                block[np.arange(n)[ok], idx[ok]] = 1.0
+                parts.append(block)
+            else:
+                parts.append(idx.astype(np.float32)[:, None])
+
+        for b in self._num_blocks:
+            arr = kept[b["col"]]
+            if arr.dtype == object:
+                arr = np.stack([np.asarray(v, np.float32).ravel() for v in arr]) \
+                    if n else np.zeros((0, b["width"]), np.float32)
+            block = arr.astype(np.float32)
+            parts.append(block.reshape(n, -1) if block.ndim != 1
+                         else block[:, None])
+
+        if self._hash_cols:
+            nf = self.numberOfFeatures
+            rows = _object_rows([
+                sparse_count_row(
+                    _tokenize_strings([kept[c][i] for c in self._hash_cols]), nf)
+                for i in range(n)])
+            parts.append(densify_sparse_column(rows, selected=self._selected))
+
+        features = (np.concatenate(parts, axis=1) if parts
+                    else np.zeros((n, 0), np.float32))
+        meta = ColumnMeta()
+        meta.extra["feature_blocks"] = [
+            {k: v for k, v in b.items()} for b in self.feature_blocks]
+        meta.extra["num_features"] = int(features.shape[1])
+        return kept.with_column(self.featuresCol, features, meta=meta)
+
+    # -- persistence ----------------------------------------------------
+    def _save_extra(self, path: str) -> None:
+        plan = {"cat_blocks": self._cat_blocks, "num_blocks": self._num_blocks,
+                "hash_cols": self._hash_cols, "clean_cols": self._clean_cols}
+        with open(os.path.join(path, "plan.json"), "w") as f:
+            json.dump(plan, f)
+        if self._selected is not None:
+            np.save(os.path.join(path, "selected.npy"), self._selected)
+
+    def _load_extra(self, path: str) -> None:
+        with open(os.path.join(path, "plan.json")) as f:
+            plan = json.load(f)
+        self._cat_blocks = plan["cat_blocks"]
+        self._num_blocks = plan["num_blocks"]
+        self._hash_cols = plan["hash_cols"]
+        self._clean_cols = plan["clean_cols"]
+        sel = os.path.join(path, "selected.npy")
+        self._selected = np.load(sel) if os.path.exists(sel) else None
+
+
+class Featurize(Estimator):
+    """Featurize several column groups, one AssembleFeatures per output
+    (reference Featurize.scala:67-82: featureColumns map -> Pipeline)."""
+
+    featureColumns = Param(None, "output col -> list of input cols",
+                           ptype=dict, required=True)
+    numberOfFeatures = Param(NUM_FEATURES_DEFAULT, "hash space", ptype=int)
+    oneHotEncodeCategoricals = Param(True, "one-hot encode categoricals",
+                                     ptype=bool)
+
+    def fit(self, table: DataTable) -> PipelineModel:
+        self._check_required()
+        stages = [
+            AssembleFeatures(
+                columnsToFeaturize=list(cols), featuresCol=out,
+                numberOfFeatures=self.numberOfFeatures,
+                oneHotEncodeCategoricals=self.oneHotEncodeCategoricals)
+            for out, cols in self.featureColumns.items()
+        ]
+        return Pipeline(stages).fit(table)

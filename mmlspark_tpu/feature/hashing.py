@@ -1,0 +1,79 @@
+"""Stable token hashing + sparse-row helpers for the hashing trick.
+
+The reference's string featurization hashes tokens with Spark's HashingTF
+into 2^18 slots and keeps only slots seen non-zero in the fit corpus
+(AssembleFeatures.scala:198-224: BitSet reduce + VectorSlicer).  Slot
+selection is what makes the TPU path dense-friendly: XLA is dense-first, so
+instead of materializing 262144-wide batches we select the observed slots
+once at fit time and emit a dense (rows, n_selected) block.
+
+Sparse rows (pre-selection) are represented as (indices:int32, values:float32)
+tuples in an object column — the host-side analogue of Spark's SparseVector.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+
+def stable_hash(token: str) -> int:
+    """Process-stable 32-bit token hash (crc32; Python's hash() is salted)."""
+    return zlib.crc32(token.encode("utf-8"))
+
+
+def hash_tokens_to_slots(tokens: Iterable[str], num_features: int) -> np.ndarray:
+    """Map tokens to slot ids in [0, num_features)."""
+    return np.asarray([stable_hash(t) % num_features for t in tokens],
+                      dtype=np.int64)
+
+
+def sparse_count_row(tokens: Sequence[str], num_features: int,
+                     binary: bool = False) -> tuple[np.ndarray, np.ndarray]:
+    """One row of term counts as (sorted unique indices, counts)."""
+    if len(tokens) == 0:
+        return (np.zeros(0, np.int32), np.zeros(0, np.float32))
+    slots = hash_tokens_to_slots(tokens, num_features)
+    idx, counts = np.unique(slots, return_counts=True)
+    vals = (np.ones(len(idx), np.float32) if binary
+            else counts.astype(np.float32))
+    return idx.astype(np.int32), vals
+
+
+def nonzero_slots(sparse_rows: Iterable[tuple[np.ndarray, np.ndarray]]) -> np.ndarray:
+    """Union of observed slot ids over the corpus (the BitSet reduce)."""
+    seen: set[int] = set()
+    for idx, _ in sparse_rows:
+        seen.update(int(i) for i in idx)
+    return np.asarray(sorted(seen), dtype=np.int32)
+
+
+def densify_sparse_column(col: np.ndarray,
+                          selected: Optional[np.ndarray] = None,
+                          num_features: Optional[int] = None) -> np.ndarray:
+    """Materialize sparse rows as a dense float32 matrix.
+
+    With `selected`, emit one dense column per selected slot (the
+    VectorSlicer path); otherwise emit the full `num_features` width.
+    """
+    n = len(col)
+    if selected is not None:
+        width = len(selected)
+        out = np.zeros((n, width), np.float32)
+        if width == 0:
+            return out
+        for r, (idx, vals) in enumerate(col):
+            if len(idx) == 0:
+                continue
+            pos = np.searchsorted(selected, idx)
+            ok = (pos < width) & (selected[np.minimum(pos, width - 1)] == idx)
+            out[r, pos[ok]] = vals[ok]
+        return out
+    if num_features is None:
+        raise ValueError("need selected slots or num_features")
+    out = np.zeros((n, num_features), np.float32)
+    for r, (idx, vals) in enumerate(col):
+        out[r, idx] = vals
+    return out

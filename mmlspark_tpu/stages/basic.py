@@ -95,16 +95,16 @@ class CheckpointData(Transformer):
 
     def transform(self, table: DataTable) -> DataTable:
         import jax
+        out = table.select(*table.columns)  # derived table; input untouched
         if self.removeCheckpoint:
-            table.__dict__.pop("_device_cache", None)
-            return table
+            return out
         cache: dict[str, object] = {}
-        for name in table.columns:
-            arr = table[name]
+        for name in out.columns:
+            arr = out[name]
             if arr.dtype != object and np.issubdtype(arr.dtype, np.number):
                 cache[name] = jax.device_put(np.ascontiguousarray(arr))
-        table.__dict__["_device_cache"] = cache
-        return table
+        out.__dict__["_device_cache"] = cache
+        return out
 
     @staticmethod
     def get_device_cache(table: DataTable) -> dict[str, object]:

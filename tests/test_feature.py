@@ -1,0 +1,172 @@
+"""Featurization layer tests (reference featurize/, text-featurizer/)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataTable
+from mmlspark_tpu.core.pipeline import load_stage
+from mmlspark_tpu.core.schema import make_categorical
+from mmlspark_tpu.feature import (
+    AssembleFeatures,
+    Featurize,
+    HashingTF,
+    IDF,
+    NGram,
+    StopWordsRemover,
+    TextFeaturizer,
+    Tokenizer,
+    densify_sparse_column,
+)
+
+
+# ------------------------------------------------------------------ text ---
+
+def test_tokenizer_defaults():
+    t = DataTable({"txt": ["Hello World", "  a  B c ", None]})
+    out = Tokenizer(inputCol="txt", outputCol="tok").transform(t)
+    assert out["tok"][0] == ["hello", "world"]
+    assert out["tok"][1] == ["a", "b", "c"]
+    assert out["tok"][2] == []
+
+
+def test_tokenizer_min_length_and_pattern():
+    t = DataTable({"txt": ["one,two,,three"]})
+    out = Tokenizer(inputCol="txt", outputCol="tok", pattern=",",
+                    minTokenLength=4).transform(t)
+    assert out["tok"][0] == ["three"]
+
+
+def test_stop_words():
+    t = DataTable({"tok": [["the", "quick", "fox"], ["a", "dog"]]})
+    out = StopWordsRemover(inputCol="tok", outputCol="f").transform(t)
+    assert out["f"][0] == ["quick", "fox"] and out["f"][1] == ["dog"]
+    custom = StopWordsRemover(inputCol="tok", outputCol="f",
+                              stopWords=["fox"]).transform(t)
+    assert custom["f"][0] == ["the", "quick"]
+
+
+def test_ngram():
+    t = DataTable({"tok": [["a", "b", "c"], ["x"]]})
+    out = NGram(inputCol="tok", outputCol="ng", n=2).transform(t)
+    assert out["ng"][0] == ["a b", "b c"] and out["ng"][1] == []
+
+
+def test_hashing_tf_counts_stable():
+    t = DataTable({"tok": [["dog", "cat", "dog"], []]})
+    out = HashingTF(inputCol="tok", outputCol="tf", numFeatures=64).transform(t)
+    idx, vals = out["tf"][0]
+    assert vals.sum() == 3 and len(idx) <= 2
+    out2 = HashingTF(inputCol="tok", outputCol="tf", numFeatures=64).transform(t)
+    assert (out2["tf"][0][0] == idx).all()  # stable across calls
+    assert out.meta("tf").extra["num_features"] == 64
+
+
+def test_idf_downweights_common_terms():
+    t = DataTable({"tok": [["common", "rare1"], ["common", "rare2"],
+                           ["common", "rare3"]]})
+    tf = HashingTF(inputCol="tok", outputCol="tf", numFeatures=128).transform(t)
+    model = IDF(inputCol="tf", outputCol="w").fit(tf)
+    out = model.transform(tf)
+    dense = densify_sparse_column(out["w"], num_features=128)
+    tf_dense = densify_sparse_column(out["tf"], num_features=128)
+    common_slot = int(np.argmax(tf_dense.sum(0)))
+    rare_slots = [s for s in np.nonzero(tf_dense.sum(0))[0] if s != common_slot]
+    # common term weight log(4/4)=0 with 3 docs all containing it; rare > 0
+    assert dense[:, common_slot].max() == pytest.approx(0.0)
+    assert all(dense[:, s].max() > 0 for s in rare_slots)
+
+
+def test_text_featurizer_end_to_end(tmp_path):
+    t = DataTable({"txt": ["The quick brown fox", "the lazy dog",
+                           "quick quick dog"]})
+    model = TextFeaturizer(inputCol="txt", outputCol="feats",
+                           useStopWordsRemover=True, numFeatures=256,
+                           useIDF=True).fit(t)
+    out = model.transform(t)
+    assert "feats" in out.columns
+    # intermediates dropped
+    assert all(not c.startswith("feats_") for c in out.columns)
+    model.save(str(tmp_path / "tf"))
+    reloaded = load_stage(str(tmp_path / "tf"))
+    out2 = reloaded.transform(t)
+    d1 = densify_sparse_column(out["feats"], num_features=256)
+    d2 = densify_sparse_column(out2["feats"], num_features=256)
+    assert np.allclose(d1, d2)
+
+
+# -------------------------------------------------------------- assemble ---
+
+@pytest.fixture
+def mixed_table():
+    return DataTable({
+        "num_int": np.arange(8, dtype=np.int64),
+        "num_float": np.linspace(0, 1, 8).astype(np.float64),
+        "cat": [f"c{i % 3}" for i in range(8)],
+        "text": [f"token{i % 4} shared" for i in range(8)],
+        "vec": np.arange(16, dtype=np.float32).reshape(8, 2),
+        "label": np.array([i % 2 for i in range(8)], dtype=np.int32),
+    })
+
+
+def test_assemble_features_mixed(mixed_table):
+    t = make_categorical(mixed_table, "cat")
+    model = AssembleFeatures(
+        columnsToFeaturize=["num_int", "num_float", "cat", "text", "vec"],
+        numberOfFeatures=4096).fit(t)
+    out = model.transform(t)
+    feats = out["features"]
+    blocks = out.meta("features").extra["feature_blocks"]
+    # categoricals first (FastVectorAssembler rule), hashed last
+    assert blocks[0]["kind"] == "categorical"
+    assert blocks[-1]["kind"] == "hashed"
+    # widths: OHE(3 levels ->2) + num(1) + num(1) + vec(2) + hashed(5 tokens)
+    assert feats.shape == (8, 2 + 1 + 1 + 2 + 5)
+    assert model.num_output_features == feats.shape[1]
+    assert feats.dtype == np.float32
+    # OHE one-hot rows sum to <= 1
+    assert (feats[:, :2].sum(axis=1) <= 1).all()
+
+
+def test_assemble_drops_missing_rows(mixed_table):
+    t = mixed_table.with_column(
+        "num_float",
+        np.where(np.arange(8) == 3, np.nan, mixed_table["num_float"]))
+    model = AssembleFeatures(columnsToFeaturize=["num_float"]).fit(t)
+    out = model.transform(t)
+    assert out.num_rows == 7
+
+
+def test_assemble_no_ohe_keeps_indices(mixed_table):
+    t = make_categorical(mixed_table, "cat")
+    model = AssembleFeatures(columnsToFeaturize=["cat", "num_int"],
+                             oneHotEncodeCategoricals=False).fit(t)
+    feats = model.transform(t)["features"]
+    assert feats.shape == (8, 2)
+    assert set(np.unique(feats[:, 0])) == {0.0, 1.0, 2.0}
+
+
+def test_assemble_rejects_nonstring_at_score(mixed_table):
+    model = AssembleFeatures(columnsToFeaturize=["text"]).fit(mixed_table)
+    bad = mixed_table.with_column("text", np.arange(8, dtype=np.float64))
+    with pytest.raises(TypeError):
+        model.transform(bad)
+
+
+def test_assemble_save_load(tmp_path, mixed_table):
+    t = make_categorical(mixed_table, "cat")
+    model = AssembleFeatures(
+        columnsToFeaturize=["num_int", "cat", "text"]).fit(t)
+    expected = model.transform(t)["features"]
+    model.save(str(tmp_path / "af"))
+    loaded = load_stage(str(tmp_path / "af"))
+    assert np.allclose(loaded.transform(t)["features"], expected)
+
+
+def test_featurize_multiple_groups(mixed_table):
+    model = Featurize(featureColumns={
+        "f1": ["num_int", "num_float"],
+        "f2": ["text"],
+    }, numberOfFeatures=1024).fit(mixed_table)
+    out = model.transform(mixed_table)
+    assert out["f1"].shape == (8, 2)
+    assert out["f2"].shape[0] == 8 and out["f2"].shape[1] > 0

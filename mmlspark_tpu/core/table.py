@@ -22,6 +22,14 @@ import numpy as np
 from mmlspark_tpu.core.schema import ColumnMeta, _json_scalar
 
 
+def object_column(values: Any) -> np.ndarray:
+    """Build a 1-D object column without numpy coercing nested sequences."""
+    values = list(values)
+    out = np.empty(len(values), dtype=object)
+    out[:] = values
+    return out
+
+
 def _as_column(values: Any) -> np.ndarray:
     if isinstance(values, np.ndarray):
         return values

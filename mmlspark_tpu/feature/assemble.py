@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -26,15 +27,9 @@ import numpy as np
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Estimator, Pipeline, PipelineModel, Transformer
 from mmlspark_tpu.core.schema import ColumnMeta
-from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.core.table import DataTable, object_column
 from mmlspark_tpu.feature.hashing import (densify_sparse_column,
                                           nonzero_slots, sparse_count_row)
-
-
-def _object_rows(rows: list) -> np.ndarray:
-    out = np.empty(len(rows), dtype=object)
-    out[:] = rows
-    return out
 
 # 2^18 slots by default; 2^12 for tree/NN learners (Featurize.scala:13-19)
 NUM_FEATURES_DEFAULT = 1 << 18
@@ -77,6 +72,9 @@ class AssembleFeatures(Estimator):
                 cat_blocks.append({
                     "col": col, "kind": "categorical",
                     "num_levels": meta.categorical.num_levels,
+                    # persist the fitted level order: score-time tables may
+                    # carry raw values or a differently-inferred encoding
+                    "levels": list(meta.categorical.levels),
                     "ohe": bool(self.oneHotEncodeCategoricals),
                 })
                 continue
@@ -108,20 +106,28 @@ class AssembleFeatures(Estimator):
                 hash_cols.append(col)
 
         selected = None
+        fit_rows = None
         if hash_cols:
             nf = self.numberOfFeatures
-            rows = (sparse_count_row(
-                        _tokenize_strings([table[c][i] for c in hash_cols]), nf)
-                    for i in range(table.num_rows))
-            selected = nonzero_slots(rows)
+            cols_data = [table[c] for c in hash_cols]
+            fit_rows = [
+                sparse_count_row(
+                    _tokenize_strings([cd[i] for cd in cols_data]), nf)
+                for i in range(table.num_rows)]
+            selected = nonzero_slots(fit_rows)
 
-        return AssembleFeaturesModel(
+        model = AssembleFeaturesModel(
             cat_blocks=cat_blocks, num_blocks=num_blocks,
             hash_cols=hash_cols, clean_cols=clean_cols,
             selected_slots=selected,
             featuresCol=self.featuresCol,
             numberOfFeatures=self.numberOfFeatures,
         )
+        if fit_rows is not None:
+            # the pipeline transforms the fit table right after fit(); reuse
+            # the hashed rows instead of re-tokenizing the whole corpus
+            model._fit_cache = (weakref.ref(table), fit_rows)
+        return model
 
 
 class AssembleFeaturesModel(Transformer):
@@ -148,6 +154,7 @@ class AssembleFeaturesModel(Transformer):
         self._clean_cols = list(clean_cols or [])
         self._selected = (np.asarray(selected_slots, np.int32)
                           if selected_slots is not None else None)
+        self._fit_cache: Optional[tuple] = None
 
     @property
     def feature_blocks(self) -> list[dict]:
@@ -166,6 +173,31 @@ class AssembleFeaturesModel(Transformer):
     def num_output_features(self) -> int:
         return int(sum(b["width"] for b in self.feature_blocks))
 
+    def _categorical_indices(self, table: DataTable, block: dict) -> np.ndarray:
+        """Indices in the FITTED level order.
+
+        Score-time tables may hold raw values (strings) or a categorical
+        encoding inferred from different data; both are re-mapped through
+        the levels saved at fit time (the reference reads them from column
+        metadata, Categoricals.scala:186-261).  Unseen values become -1 and
+        one-hot to all zeros.
+        """
+        from mmlspark_tpu.core.schema import CategoricalMap
+        arr = table[block["col"]]
+        fitted = CategoricalMap(block["levels"])
+        own = table.meta(block["col"]).categorical
+        if own is not None:
+            if list(own.levels) == block["levels"]:
+                return np.asarray(arr, np.int64)
+            # re-encoded with different levels: decode then re-map
+            return fitted.to_indices(list(own.to_levels(arr))).astype(np.int64)
+        if arr.dtype == object or np.issubdtype(arr.dtype, np.str_):
+            return fitted.to_indices(list(arr)).astype(np.int64)
+        # raw numeric values that match the fitted levels
+        if set(np.unique(arr).tolist()) <= set(block["levels"]):
+            return fitted.to_indices(arr.tolist()).astype(np.int64)
+        return np.asarray(arr, np.int64)
+
     def transform(self, table: DataTable) -> DataTable:
         for col in self._hash_cols:
             if table[col].dtype != object and not np.issubdtype(
@@ -178,7 +210,7 @@ class AssembleFeaturesModel(Transformer):
         parts: list[np.ndarray] = []
 
         for b in self._cat_blocks:
-            idx = np.asarray(kept[b["col"]], np.int64)
+            idx = self._categorical_indices(kept, b)
             if b["ohe"]:
                 # Spark OneHotEncoder dropLast: last level encodes as zeros
                 width = max(b["num_levels"] - 1, 0)
@@ -200,11 +232,18 @@ class AssembleFeaturesModel(Transformer):
 
         if self._hash_cols:
             nf = self.numberOfFeatures
-            rows = _object_rows([
-                sparse_count_row(
-                    _tokenize_strings([kept[c][i] for c in self._hash_cols]), nf)
-                for i in range(n)])
-            parts.append(densify_sparse_column(rows, selected=self._selected))
+            rows = None
+            cache = self._fit_cache
+            if (cache is not None and cache[0]() is table
+                    and kept.num_rows == table.num_rows):
+                rows = cache[1]
+            if rows is None:
+                cols_data = [kept[c] for c in self._hash_cols]
+                rows = [sparse_count_row(
+                            _tokenize_strings([cd[i] for cd in cols_data]), nf)
+                        for i in range(n)]
+            parts.append(densify_sparse_column(object_column(rows),
+                                               selected=self._selected))
 
         features = (np.concatenate(parts, axis=1) if parts
                     else np.zeros((n, 0), np.float32))

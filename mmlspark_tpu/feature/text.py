@@ -21,7 +21,7 @@ import numpy as np
 from mmlspark_tpu.core.params import Param, domain
 from mmlspark_tpu.core.pipeline import (Estimator, PipelineModel, Transformer,
                                         load_stage)
-from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.core.table import DataTable, object_column as _object_column
 from mmlspark_tpu.feature.hashing import sparse_count_row
 
 # A standard English stop-word list (the usual Porter/SMART subset Spark's
@@ -41,12 +41,6 @@ we'd we'll we're we've were weren't what what's when when's where where's
 which while who who's whom why why's with won't would wouldn't you you'd
 you'll you're you've your yours yourself yourselves
 """.split())
-
-
-def _object_column(values: list) -> np.ndarray:
-    out = np.empty(len(values), dtype=object)
-    out[:] = values
-    return out
 
 
 class Tokenizer(Transformer):
@@ -215,6 +209,11 @@ class TextFeaturizerModel(PipelineModel):
         self._drop = list(cols_to_drop or [])
 
     def transform(self, table: DataTable) -> DataTable:
+        clash = [c for c in self._drop if c in table]
+        if clash:
+            raise ValueError(
+                f"input table already has columns {clash}, which this fitted "
+                "model uses as intermediates; rename them before scoring")
         out = super().transform(table)
         return out.drop(*[c for c in self._drop if c in out])
 

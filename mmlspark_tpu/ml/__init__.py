@@ -1,0 +1,26 @@
+"""AutoML layer (reference L4: train-classifier, train-regressor,
+compute-model-statistics, compute-per-instance-statistics, find-best-model)."""
+
+from mmlspark_tpu.ml.learners import (
+    LinearRegression,
+    LogisticRegression,
+    MultilayerPerceptronClassifier,
+    NaiveBayes,
+    OneVsRest,
+)
+from mmlspark_tpu.ml.train_classifier import TrainClassifier, TrainedClassifierModel
+from mmlspark_tpu.ml.train_regressor import TrainRegressor, TrainedRegressorModel
+from mmlspark_tpu.ml.statistics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+)
+from mmlspark_tpu.ml.find_best_model import BestModel, FindBestModel
+
+__all__ = [
+    "LogisticRegression", "LinearRegression", "NaiveBayes",
+    "MultilayerPerceptronClassifier", "OneVsRest",
+    "TrainClassifier", "TrainedClassifierModel",
+    "TrainRegressor", "TrainedRegressorModel",
+    "ComputeModelStatistics", "ComputePerInstanceStatistics",
+    "FindBestModel", "BestModel",
+]

@@ -1,0 +1,119 @@
+"""Model selection over one evaluation dataset.
+
+TPU-native counterpart of find-best-model (FindBestModel.scala:68-331):
+score each candidate model on the eval table, compare on the chosen metric
+with the right direction (higher-is-better for accuracy/precision/recall/
+AUC/r2, lower for mse/rmse/mae), and return a BestModel exposing the
+winner plus the all-models comparison table and the winner's ROC.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Estimator, Transformer, load_stage
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.ml.statistics import (ACCURACY, AUC, MAE, METRIC_TO_COLUMN,
+                                        MSE, PRECISION, R2, RECALL, RMSE,
+                                        ComputeModelStatistics)
+
+_LOWER_IS_BETTER = {MSE, RMSE, MAE}
+
+
+class FindBestModel(Estimator):
+    """Pick the best of several trained models on an eval table."""
+
+    evaluationMetric = Param(ACCURACY, "metric to rank models by", ptype=str,
+                             domain=(ACCURACY, PRECISION, RECALL, AUC,
+                                     MSE, RMSE, R2, MAE))
+
+    def __init__(self, models: Optional[list[Transformer]] = None, **kw):
+        super().__init__(**kw)
+        self._models = list(models or [])
+
+    def set_models(self, models: list[Transformer]) -> "FindBestModel":
+        self._models = list(models)
+        return self
+
+    def fit(self, table: DataTable) -> "BestModel":
+        if not self._models:
+            raise ValueError("FindBestModel: no models to compare")
+        metric = self.evaluationMetric
+        col_name = METRIC_TO_COLUMN[metric]
+        lower = metric in _LOWER_IS_BETTER
+
+        rows = []
+        best = None
+        for model in self._models:
+            scored = model.transform(table)
+            evaluator = ComputeModelStatistics()
+            metrics = evaluator.transform(scored)
+            if col_name not in metrics:
+                raise ValueError(
+                    f"metric '{metric}' not produced for model "
+                    f"{type(model).__name__} (wrong model kind?)")
+            value = float(metrics[col_name][0])
+            rows.append({"model_name": model.uid,
+                         **{c: float(metrics[c][0]) for c in metrics.columns}})
+            if best is None or (value < best[1] if lower else value > best[1]):
+                best = (model, value, metrics, evaluator)
+        best_model, best_value, best_metrics, best_eval = best
+        return BestModel(best_model, best_metrics,
+                         DataTable.from_rows(rows),
+                         roc=best_eval.last_roc,
+                         evaluationMetric=metric)
+
+
+class BestModel(Transformer):
+    """The chosen model + comparison tables (FindBestModel.scala:174-227)."""
+
+    evaluationMetric = Param(ACCURACY, "metric models were ranked by", ptype=str)
+
+    def __init__(self, best_model: Optional[Transformer] = None,
+                 best_metrics: Optional[DataTable] = None,
+                 all_model_metrics: Optional[DataTable] = None,
+                 roc: Optional[tuple] = None, **kw):
+        super().__init__(**kw)
+        self._best = best_model
+        self._best_metrics = best_metrics
+        self._all_metrics = all_model_metrics
+        self._roc = roc
+
+    @property
+    def best_model(self) -> Transformer:
+        return self._best
+
+    def get_evaluation_results(self) -> DataTable:
+        return self._best_metrics
+
+    def get_all_model_metrics(self) -> DataTable:
+        return self._all_metrics
+
+    def get_roc_curve(self) -> DataTable:
+        if self._roc is None:
+            raise ValueError("best model produced no binary ROC")
+        fpr, tpr, thr = self._roc
+        return DataTable({"false_positive_rate": fpr,
+                          "true_positive_rate": tpr, "threshold": thr})
+
+    def transform(self, table: DataTable) -> DataTable:
+        return self._best.transform(table)
+
+    def _save_extra(self, path: str) -> None:
+        self._best.save(os.path.join(path, "best"))
+        if self._best_metrics is not None:
+            self._best_metrics.save(os.path.join(path, "best_metrics"))
+        if self._all_metrics is not None:
+            self._all_metrics.save(os.path.join(path, "all_metrics"))
+
+    def _load_extra(self, path: str) -> None:
+        self._best = load_stage(os.path.join(path, "best"))
+        bm = os.path.join(path, "best_metrics")
+        am = os.path.join(path, "all_metrics")
+        self._best_metrics = DataTable.load(bm) if os.path.exists(bm) else None
+        self._all_metrics = DataTable.load(am) if os.path.exists(am) else None
+        self._roc = None

@@ -1,0 +1,253 @@
+"""Model evaluation: aggregate and per-instance statistics.
+
+TPU-native counterpart of compute-model-statistics and
+compute-per-instance-statistics (ComputeModelStatistics.scala:104-530,
+ComputePerInstanceStatistics.scala:36-92).  Scored columns are discovered
+through the `mml` metadata protocol (core/schema.py), never by hard-coded
+names — the same contract the reference relies on
+(ComputeModelStatistics.scala:205-218).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Evaluator, Transformer
+from mmlspark_tpu.core.schema import SchemaConstants, find_score_columns
+from mmlspark_tpu.core.table import DataTable
+
+# metric names (ComputeModelStatistics.scala:26-69)
+MSE, RMSE, R2, MAE = "mse", "rmse", "r2", "mae"
+AUC, ACCURACY, PRECISION, RECALL = "AUC", "accuracy", "precision", "recall"
+ALL_METRICS = "all"
+MSE_COL = "mean_squared_error"
+RMSE_COL = "root_mean_squared_error"
+R2_COL = "R^2"
+MAE_COL = "mean_absolute_error"
+AVG_ACCURACY = "average_accuracy"
+MACRO_RECALL = "macro_averaged_recall"
+MACRO_PRECISION = "macro_averaged_precision"
+
+METRIC_TO_COLUMN = {MSE: MSE_COL, RMSE: RMSE_COL, R2: R2_COL, MAE: MAE_COL,
+                    AUC: AUC, ACCURACY: ACCURACY, PRECISION: PRECISION,
+                    RECALL: RECALL}
+CLASSIFICATION_METRICS = {ACCURACY, PRECISION, RECALL, AUC}
+REGRESSION_METRICS = {MSE, RMSE, R2, MAE}
+
+
+def _schema_info(table: DataTable, label_fallback: Optional[str]):
+    """Resolve (model_kind, label_col, scores_col, scored_labels_col,
+    probabilities_col) from metadata (getSchemaInfo, scala:205-218)."""
+    cols = find_score_columns(table)
+    if not cols:
+        raise ValueError(
+            "no scored columns found in table metadata; score the table "
+            "with a trained model first")
+    C = SchemaConstants
+    any_col = next(iter(cols.values()))
+    kind = table.meta(any_col).model_kind
+    label = cols.get(C.TRUE_LABELS_COLUMN) or label_fallback
+    if label is None or label not in table:
+        raise ValueError("no true-label column found (metadata or labelCol)")
+    return (kind, label, cols.get(C.SCORES_COLUMN),
+            cols.get(C.SCORED_LABELS_COLUMN),
+            cols.get(C.SCORED_PROBABILITIES_COLUMN))
+
+
+def _label_indices(table: DataTable, label: str,
+                   pred_col: Optional[str]) -> np.ndarray:
+    """True labels as class indices.
+
+    At score time the label column may still hold raw values (strings);
+    they are mapped through the scored-labels categorical levels carried by
+    the trained model (TrainClassifier.scala:253-263), the same resolution
+    the reference evaluator performs via metadata.
+    """
+    arr = table[label]
+    own = table.meta(label).categorical
+    if own is not None:
+        return np.asarray(arr, np.int64)
+    levels = (table.meta(pred_col).categorical
+              if pred_col is not None and pred_col in table else None)
+    if arr.dtype == object or np.issubdtype(arr.dtype, np.str_):
+        if levels is None:
+            raise ValueError(
+                f"label column '{label}' is non-numeric and no levels are "
+                "available on the scored labels")
+        idx = levels.to_indices(list(arr)).astype(np.int64)
+    else:
+        vals = np.asarray(arr, np.float64)
+        if levels is not None and not set(np.unique(vals)).issubset(
+                set(range(levels.num_levels))):
+            idx = levels.to_indices(list(arr.tolist())).astype(np.int64)
+        else:
+            return vals.astype(np.int64)
+    if (idx < 0).any():
+        unseen = sorted({str(v) for v, i in zip(arr, idx) if i < 0})[:5]
+        raise ValueError(
+            f"label column '{label}' contains values never seen at train "
+            f"time: {unseen}; metrics would be silently wrong")
+    return idx
+
+
+def confusion_matrix(y_true: np.ndarray, y_pred: np.ndarray,
+                     n_classes: Optional[int] = None) -> np.ndarray:
+    """Row = true class, column = predicted (scala:461-484)."""
+    yt = np.asarray(y_true, np.int64)
+    yp = np.asarray(y_pred, np.int64)
+    k = n_classes or int(max(yt.max(initial=0), yp.max(initial=0))) + 1
+    cm = np.zeros((k, k), np.int64)
+    np.add.at(cm, (yt, yp), 1)
+    return cm
+
+
+def roc_curve(y_true: np.ndarray, scores: np.ndarray):
+    """(fpr, tpr, thresholds), sweeping the decision threshold."""
+    y = np.asarray(y_true, np.float64)
+    s = np.asarray(scores, np.float64)
+    order = np.argsort(-s, kind="stable")
+    y, s = y[order], s[order]
+    distinct = np.where(np.diff(s))[0]
+    idx = np.concatenate([distinct, [len(y) - 1]])
+    tps = np.cumsum(y)[idx]
+    fps = (idx + 1) - tps
+    P = max(y.sum(), 1e-12)
+    N = max(len(y) - y.sum(), 1e-12)
+    tpr = np.concatenate([[0.0], tps / P])
+    fpr = np.concatenate([[0.0], fps / N])
+    thresholds = np.concatenate([[np.inf], s[idx]])
+    return fpr, tpr, thresholds
+
+
+def auc_score(y_true: np.ndarray, scores: np.ndarray) -> float:
+    fpr, tpr, _ = roc_curve(y_true, scores)
+    return float(np.trapezoid(tpr, fpr))
+
+
+class ComputeModelStatistics(Evaluator):
+    """Emit a one-row metrics table for a scored table.
+
+    After transform, `last_confusion_matrix` and `last_roc` hold the
+    confusion matrix / ROC points of the evaluation (the data the reference
+    logged through MetricData, scala:486-521).
+    """
+
+    evaluationMetric = Param(ALL_METRICS, "metric to compute ('all' or one "
+                             "of accuracy/precision/recall/AUC/mse/rmse/r2/mae)",
+                             ptype=str)
+    labelCol = Param(None, "fallback true-label column when metadata has none",
+                     ptype=str)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.last_confusion_matrix: Optional[np.ndarray] = None
+        self.last_roc: Optional[tuple] = None
+
+    def transform(self, table: DataTable) -> DataTable:
+        kind, label, scores, scored_labels, probs = _schema_info(
+            table, self.labelCol)
+        metric = self.evaluationMetric
+        if kind == SchemaConstants.REGRESSION_KIND:
+            return self._regression(table, label, scores, metric)
+        return self._classification(table, label, scores, scored_labels,
+                                    probs, metric)
+
+    # -- regression (scala:186-203) --------------------------------------
+    def _regression(self, table, label, scores, metric) -> DataTable:
+        y = np.asarray(table[label], np.float64)
+        pred = np.asarray(table[scores], np.float64)
+        err = y - pred
+        mse = float(np.mean(err ** 2))
+        out = {MSE_COL: mse, RMSE_COL: float(np.sqrt(mse)),
+               R2_COL: float(1.0 - mse / max(np.var(y), 1e-24)),
+               MAE_COL: float(np.mean(np.abs(err)))}
+        if metric in REGRESSION_METRICS:
+            out = {METRIC_TO_COLUMN[metric]: out[METRIC_TO_COLUMN[metric]]}
+        return DataTable({k: [v] for k, v in out.items()})
+
+    # -- classification (scala:143-185, 375-447) -------------------------
+    def _classification(self, table, label, scores, scored_labels, probs,
+                        metric) -> DataTable:
+        pred_col = scored_labels or scores
+        y = _label_indices(table, label, pred_col)
+        yp = np.asarray(table[pred_col], np.float64).astype(np.int64)
+        levels = table.meta(pred_col).categorical
+        n_classes = max(
+            levels.num_levels if levels is not None else 0,
+            int(max(y.max(initial=0), yp.max(initial=0))) + 1, 2)
+        cm = confusion_matrix(y, yp, n_classes)
+        self.last_confusion_matrix = cm
+
+        out: dict[str, float] = {}
+        if n_classes == 2:
+            tn, fp, fn, tp = cm[0, 0], cm[0, 1], cm[1, 0], cm[1, 1]
+            total = cm.sum()
+            out[ACCURACY] = float((tp + tn) / max(total, 1))
+            out[PRECISION] = float(tp / max(tp + fp, 1))
+            out[RECALL] = float(tp / max(tp + fn, 1))
+            if probs is not None:
+                p = np.asarray(table[probs], np.float64)
+                pos = p[:, 1] if p.ndim == 2 else p
+                self.last_roc = roc_curve(y, pos)
+                out[AUC] = auc_score(y, pos)
+        else:
+            # micro-averaged accuracy == overall accuracy; macro averages
+            # per-class (scala:375-429)
+            diag = np.diag(cm).astype(np.float64)
+            row = cm.sum(axis=1).astype(np.float64)  # per true class
+            col = cm.sum(axis=0).astype(np.float64)  # per predicted class
+            micro = float(diag.sum() / max(cm.sum(), 1))
+            out[ACCURACY] = micro
+            out[PRECISION] = micro   # micro precision == micro recall == acc
+            out[RECALL] = micro
+            out[AVG_ACCURACY] = float(np.mean(
+                (cm.sum() - row - col + 2 * diag) / max(cm.sum(), 1)))
+            out[MACRO_PRECISION] = float(np.mean(diag / np.maximum(col, 1)))
+            out[MACRO_RECALL] = float(np.mean(diag / np.maximum(row, 1)))
+            if metric == AUC:
+                raise ValueError("AUC is not available for multiclass "
+                                 "(scala:173)")
+        if metric in CLASSIFICATION_METRICS and metric in out:
+            out = {metric: out[metric]}
+        return DataTable({k: [v] for k, v in out.items()})
+
+    def confusion_matrix_table(self) -> DataTable:
+        cm = self.last_confusion_matrix
+        if cm is None:
+            raise ValueError("transform a scored table first")
+        return DataTable({f"pred_{j}": cm[:, j] for j in range(cm.shape[1])})
+
+    def roc_curve_table(self) -> DataTable:
+        if self.last_roc is None:
+            raise ValueError("no binary ROC computed yet")
+        fpr, tpr, thr = self.last_roc
+        return DataTable({"false_positive_rate": fpr,
+                          "true_positive_rate": tpr, "threshold": thr})
+
+
+class ComputePerInstanceStatistics(Evaluator):
+    """Per-row metrics: log-loss for classification, L1/L2 loss for
+    regression (ComputePerInstanceStatistics.scala:36-92)."""
+
+    labelCol = Param(None, "fallback true-label column", ptype=str)
+
+    def transform(self, table: DataTable) -> DataTable:
+        kind, label, scores, scored_labels, probs = _schema_info(
+            table, self.labelCol)
+        if kind == SchemaConstants.REGRESSION_KIND:
+            y = np.asarray(table[label], np.float64)
+            pred = np.asarray(table[scores], np.float64)
+            out = table.with_column("L1_loss", np.abs(y - pred))
+            return out.with_column("L2_loss", (y - pred) ** 2)
+        if probs is None:
+            raise ValueError("classification per-instance stats need a "
+                             "scored-probabilities column")
+        y = _label_indices(table, label, scored_labels)
+        p = np.asarray(table[probs], np.float64)
+        idx = np.clip(y, 0, p.shape[1] - 1)
+        true_p = p[np.arange(len(y)), idx]
+        log_loss = -np.log(np.maximum(true_p, 1e-15))
+        return table.with_column("log_loss", log_loss)

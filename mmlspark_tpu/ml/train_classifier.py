@@ -1,0 +1,181 @@
+"""TrainClassifier: auto-ML classification estimator.
+
+TPU-native counterpart of the reference's train-classifier
+(TrainClassifier.scala:49-160): index the label to categorical (keeping the
+levels), pick featurization settings per learner family (hash-space size,
+one-hot on/off), featurize every non-label column, autosize the MLP input
+layer, fit the learner, and return a model whose transform tags the scored
+columns in metadata (lines 213-264) so evaluators find them without
+hard-coded names.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import (Estimator, PipelineModel, Transformer,
+                                        load_stage)
+from mmlspark_tpu.core.schema import (CategoricalMap, SchemaConstants,
+                                      make_categorical, set_score_column)
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.feature.assemble import (NUM_FEATURES_DEFAULT,
+                                           NUM_FEATURES_TREE_OR_NN, Featurize)
+from mmlspark_tpu.ml.learners import (LogisticRegression,
+                                      MultilayerPerceptronClassifier,
+                                      OneVsRest)
+
+_TREE_LEARNERS = ("DecisionTreeClassifier", "RandomForestClassifier",
+                  "GBTClassifier", "DecisionTreeRegressor",
+                  "RandomForestRegressor", "GBTRegressor")
+
+
+def _is_tree(est) -> bool:
+    return type(est).__name__ in _TREE_LEARNERS
+
+
+class TrainClassifier(Estimator):
+    """Featurize + fit a classifier with label indexing."""
+
+    labelCol = Param("label", "label column", ptype=str)
+    featuresCol = Param("features", "assembled features column", ptype=str)
+    numFeatures = Param(0, "hash space size (0 = per-learner default, "
+                        "Featurize.scala:13-19)", ptype=int)
+    indexLabel = Param(True, "convert label to categorical indices", ptype=bool)
+
+    def __init__(self, model: Optional[Estimator] = None, **kw):
+        super().__init__(**kw)
+        self._model = model
+
+    def set_model(self, model: Estimator) -> "TrainClassifier":
+        self._model = model
+        return self
+
+    def fit(self, table: DataTable) -> "TrainedClassifierModel":
+        learner = self._model if self._model is not None else LogisticRegression()
+        label = self.labelCol
+        data = table.drop_nulls([label])
+
+        levels: Optional[list] = None
+        if self.indexLabel:
+            if not data.meta(label).is_categorical:
+                data = make_categorical(data, label)
+            cmap = data.meta(label).categorical
+            levels = list(cmap.levels)
+
+        # per-learner featurization config (TrainClassifier.scala:74-86)
+        is_tree = _is_tree(learner)
+        is_mlp = isinstance(learner, MultilayerPerceptronClassifier)
+        one_hot = not is_tree
+        num_features = self.numFeatures or (
+            NUM_FEATURES_TREE_OR_NN if (is_tree or is_mlp)
+            else NUM_FEATURES_DEFAULT)
+
+        # class count: from the levels, or from the raw integer labels when
+        # indexLabel is off
+        if levels is not None:
+            n_classes = len(levels)
+        else:
+            y = np.asarray(data[label], np.float64)
+            n_classes = int(y.max(initial=0)) + 1 if len(y) else 2
+
+        # multiclass LR -> one-vs-rest (TrainClassifier.scala:87-95)
+        if isinstance(learner, LogisticRegression) and n_classes > 2:
+            learner = OneVsRest(learner)
+
+        feature_cols = [c for c in data.columns if c != label]
+        featurizer = Featurize(
+            featureColumns={self.featuresCol: feature_cols},
+            numberOfFeatures=num_features,
+            oneHotEncodeCategoricals=one_hot)
+        featurized_model = featurizer.fit(data)
+        processed = featurized_model.transform(data)
+
+        # MLP input autosizing (TrainClassifier.scala:143-150)
+        if is_mlp:
+            dim = processed[self.featuresCol].shape[1]
+            layers = list(learner.layers or [-1, 100, -1])
+            layers[0] = dim
+            if layers[-1] in (-1, 0, None):
+                layers[-1] = max(n_classes, 2)
+            learner = learner.copy(layers=layers)
+
+        learner.set_params(featuresCol=self.featuresCol, labelCol=label)
+        fit_model = learner.fit(processed)
+        pipeline = PipelineModel([featurized_model, fit_model])
+        return TrainedClassifierModel(
+            pipeline, levels=levels, labelCol=label,
+            featuresCol=self.featuresCol)
+
+    def _save_extra(self, path: str) -> None:
+        if self._model is not None:
+            self._model.save(os.path.join(path, "model"))
+
+    def _load_extra(self, path: str) -> None:
+        p = os.path.join(path, "model")
+        self._model = load_stage(p) if os.path.exists(p) else None
+
+
+class TrainedClassifierModel(Transformer):
+    """Scores a table and tags scored columns in metadata
+    (TrainClassifier.scala:213-264)."""
+
+    labelCol = Param("label", "label column", ptype=str)
+    featuresCol = Param("features", "features column", ptype=str)
+
+    def __init__(self, pipeline: Optional[PipelineModel] = None,
+                 levels: Optional[list] = None, **kw):
+        super().__init__(**kw)
+        self._pipeline = pipeline
+        self._levels = list(levels) if levels is not None else None
+
+    @property
+    def levels(self) -> Optional[list]:
+        return self._levels
+
+    @property
+    def featurized_model(self):
+        return self._pipeline.get_stages()[0] if self._pipeline else None
+
+    @property
+    def fit_model(self):
+        return self._pipeline.get_stages()[-1] if self._pipeline else None
+
+    def transform(self, table: DataTable) -> DataTable:
+        out = self._pipeline.transform(table)
+        C = SchemaConstants
+        renames = {"rawPrediction": C.SCORES_COLUMN,
+                   "probability": C.SCORED_PROBABILITIES_COLUMN,
+                   "prediction": C.SCORED_LABELS_COLUMN}
+        out = out.rename({k: v for k, v in renames.items() if k in out})
+        for kind, col in ((C.SCORES_COLUMN, C.SCORES_COLUMN),
+                          (C.SCORED_PROBABILITIES_COLUMN,
+                           C.SCORED_PROBABILITIES_COLUMN),
+                          (C.SCORED_LABELS_COLUMN, C.SCORED_LABELS_COLUMN)):
+            if col in out:
+                set_score_column(out, self.uid, col, kind,
+                                 C.CLASSIFICATION_KIND)
+        if self.labelCol in out:
+            set_score_column(out, self.uid, self.labelCol,
+                             C.TRUE_LABELS_COLUMN, C.CLASSIFICATION_KIND)
+        # carry the label levels on the scored labels (scala:253-263)
+        if self._levels is not None and C.SCORED_LABELS_COLUMN in out:
+            meta = out.meta(C.SCORED_LABELS_COLUMN)
+            meta.categorical = CategoricalMap(list(self._levels))
+            out.set_meta(C.SCORED_LABELS_COLUMN, meta)
+        return out
+
+    # -- persistence ----------------------------------------------------
+    def _save_extra(self, path: str) -> None:
+        self._pipeline.save(os.path.join(path, "pipeline"))
+        with open(os.path.join(path, "levels.json"), "w") as f:
+            json.dump(self._levels, f)
+
+    def _load_extra(self, path: str) -> None:
+        self._pipeline = load_stage(os.path.join(path, "pipeline"))
+        with open(os.path.join(path, "levels.json")) as f:
+            self._levels = json.load(f)

@@ -1,0 +1,103 @@
+"""TrainRegressor: auto-ML regression estimator.
+
+TPU-native counterpart of the reference's train-regressor
+(TrainRegressor.scala:43-117): cast the label to double, drop rows with
+missing labels, featurize the remaining columns (same per-learner settings
+as TrainClassifier), fit, and tag scored columns as regression outputs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import (Estimator, PipelineModel, Transformer,
+                                        load_stage)
+from mmlspark_tpu.core.schema import SchemaConstants, set_score_column
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.feature.assemble import (NUM_FEATURES_DEFAULT,
+                                           NUM_FEATURES_TREE_OR_NN, Featurize)
+from mmlspark_tpu.ml.learners import LinearRegression
+from mmlspark_tpu.ml.train_classifier import _is_tree
+
+
+class TrainRegressor(Estimator):
+    labelCol = Param("label", "target column", ptype=str)
+    featuresCol = Param("features", "assembled features column", ptype=str)
+    numFeatures = Param(0, "hash space size (0 = per-learner default)",
+                        ptype=int)
+
+    def __init__(self, model: Optional[Estimator] = None, **kw):
+        super().__init__(**kw)
+        self._model = model
+
+    def set_model(self, model: Estimator) -> "TrainRegressor":
+        self._model = model
+        return self
+
+    def fit(self, table: DataTable) -> "TrainedRegressorModel":
+        learner = self._model if self._model is not None else LinearRegression()
+        label = self.labelCol
+        data = table.drop_nulls([label])
+        # label -> double (TrainRegressor.scala:77-95)
+        data = data.with_column(label, np.asarray(data[label], np.float64))
+
+        is_tree = _is_tree(learner)
+        num_features = self.numFeatures or (
+            NUM_FEATURES_TREE_OR_NN if is_tree else NUM_FEATURES_DEFAULT)
+        feature_cols = [c for c in data.columns if c != label]
+        featurizer = Featurize(
+            featureColumns={self.featuresCol: feature_cols},
+            numberOfFeatures=num_features,
+            oneHotEncodeCategoricals=not is_tree)
+        featurized_model = featurizer.fit(data)
+        processed = featurized_model.transform(data)
+
+        learner.set_params(featuresCol=self.featuresCol, labelCol=label)
+        fit_model = learner.fit(processed)
+        pipeline = PipelineModel([featurized_model, fit_model])
+        return TrainedRegressorModel(pipeline, labelCol=label,
+                                     featuresCol=self.featuresCol)
+
+    def _save_extra(self, path: str) -> None:
+        if self._model is not None:
+            self._model.save(os.path.join(path, "model"))
+
+    def _load_extra(self, path: str) -> None:
+        p = os.path.join(path, "model")
+        self._model = load_stage(p) if os.path.exists(p) else None
+
+
+class TrainedRegressorModel(Transformer):
+    labelCol = Param("label", "target column", ptype=str)
+    featuresCol = Param("features", "features column", ptype=str)
+
+    def __init__(self, pipeline: Optional[PipelineModel] = None, **kw):
+        super().__init__(**kw)
+        self._pipeline = pipeline
+
+    @property
+    def fit_model(self):
+        return self._pipeline.get_stages()[-1] if self._pipeline else None
+
+    def transform(self, table: DataTable) -> DataTable:
+        out = self._pipeline.transform(table)
+        C = SchemaConstants
+        if "prediction" in out:
+            out = out.rename({"prediction": C.SCORES_COLUMN})
+        if C.SCORES_COLUMN in out:
+            set_score_column(out, self.uid, C.SCORES_COLUMN, C.SCORES_COLUMN,
+                             C.REGRESSION_KIND)
+        if self.labelCol in out:
+            set_score_column(out, self.uid, self.labelCol,
+                             C.TRUE_LABELS_COLUMN, C.REGRESSION_KIND)
+        return out
+
+    def _save_extra(self, path: str) -> None:
+        self._pipeline.save(os.path.join(path, "pipeline"))
+
+    def _load_extra(self, path: str) -> None:
+        self._pipeline = load_stage(os.path.join(path, "pipeline"))

@@ -274,8 +274,13 @@ def make_categorical(table, column: str, levels: Optional[list] = None,
         for v in vals_list:
             if v not in seen:
                 seen[v] = len(seen)
-        levels = sorted(seen, key=lambda v: (str(type(v)), str(v))) if not ordinal \
-            else list(seen)
+        if ordinal:
+            levels = list(seen)
+        elif all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                 for v in seen):
+            levels = sorted(seen)  # numeric order, NOT string order
+        else:
+            levels = sorted(seen, key=lambda v: (str(type(v)), str(v)))
     cmap = CategoricalMap(list(levels), ordinal=ordinal)
     indices = cmap.to_indices(vals_list)
     out = output_col or column

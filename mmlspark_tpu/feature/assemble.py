@@ -234,6 +234,7 @@ class AssembleFeaturesModel(Transformer):
             nf = self.numberOfFeatures
             rows = None
             cache = self._fit_cache
+            self._fit_cache = None  # single-shot: free the corpus rows
             if (cache is not None and cache[0]() is table
                     and kept.num_rows == table.num_rows):
                 rows = cache[1]

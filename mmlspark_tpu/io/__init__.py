@@ -1,0 +1,6 @@
+"""IO layer: binary-file and image ingestion (reference L2: readers/)."""
+
+from mmlspark_tpu.io.files import list_files, read_binary_files
+from mmlspark_tpu.io.image_reader import decode_bytes, read_images
+
+__all__ = ["list_files", "read_binary_files", "read_images", "decode_bytes"]

@@ -62,8 +62,16 @@ class FindBestModel(Estimator):
             if best is None or (value < best[1] if lower else value > best[1]):
                 best = (model, value, metrics, evaluator)
         best_model, best_value, best_metrics, best_eval = best
+        # models of different arities emit different metric columns (binary
+        # AUC vs multiclass macro_*): take the union, NaN where absent
+        all_cols: list[str] = []
+        for r in rows:
+            for k in r:
+                if k not in all_cols:
+                    all_cols.append(k)
+        table_cols = {c: [r.get(c, np.nan) for r in rows] for c in all_cols}
         return BestModel(best_model, best_metrics,
-                         DataTable.from_rows(rows),
+                         DataTable(table_cols),
                          roc=best_eval.last_roc,
                          evaluationMetric=metric)
 

@@ -68,11 +68,17 @@ def _label_indices(table: DataTable, label: str,
     """
     arr = table[label]
     own = table.meta(label).categorical
-    if own is not None:
-        return np.asarray(arr, np.int64)
     levels = (table.meta(pred_col).categorical
               if pred_col is not None and pred_col in table else None)
-    if arr.dtype == object or np.issubdtype(arr.dtype, np.str_):
+    if own is not None:
+        # the label's own encoding is authoritative only if it matches the
+        # model's fitted levels; otherwise decode + re-map (same rule as
+        # feature columns, assemble.py _categorical_indices)
+        if levels is None or list(own.levels) == list(levels.levels):
+            return np.asarray(arr, np.int64)
+        values = list(own.to_levels(np.asarray(arr, np.int64)))
+        idx = levels.to_indices(values).astype(np.int64)
+    elif arr.dtype == object or np.issubdtype(arr.dtype, np.str_):
         if levels is None:
             raise ValueError(
                 f"label column '{label}' is non-numeric and no levels are "
@@ -80,17 +86,29 @@ def _label_indices(table: DataTable, label: str,
         idx = levels.to_indices(list(arr)).astype(np.int64)
     else:
         vals = np.asarray(arr, np.float64)
-        if levels is not None and not set(np.unique(vals)).issubset(
-                set(range(levels.num_levels))):
-            idx = levels.to_indices(list(arr.tolist())).astype(np.int64)
-        else:
+        if levels is None:
             return vals.astype(np.int64)
+        # raw numeric values: predictions live in fitted-level index space,
+        # so map raw values through the levels whenever they match them;
+        # only treat values as indices if they can't be raw level values
+        uniq = set(np.unique(vals).tolist())
+        if uniq <= set(_as_plain(levels.levels)):
+            idx = levels.to_indices(vals.tolist()).astype(np.int64)
+        elif uniq <= set(range(levels.num_levels)):
+            return vals.astype(np.int64)
+        else:
+            idx = np.full(len(vals), -1, np.int64)
     if (idx < 0).any():
         unseen = sorted({str(v) for v, i in zip(arr, idx) if i < 0})[:5]
         raise ValueError(
             f"label column '{label}' contains values never seen at train "
             f"time: {unseen}; metrics would be silently wrong")
     return idx
+
+
+def _as_plain(levels) -> list:
+    return [float(v) if isinstance(v, (int, float)) and not isinstance(v, bool)
+            else v for v in levels]
 
 
 def confusion_matrix(y_true: np.ndarray, y_pred: np.ndarray,

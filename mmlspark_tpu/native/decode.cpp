@@ -1,0 +1,152 @@
+// Host-side image decoding: JPEG (libjpeg) + PNG (libpng) -> BGR uint8.
+//
+// TPU-native replacement for the reference's OpenCV imgcodecs JNI decode
+// (ImageReader.scala:25-40: Imgcodecs.imdecode per row inside a Spark UDF).
+// Decode must stay host-side (bitstream parsing is irreducibly scalar); the
+// decoded tensors then batch onto the device for every later op.  Output is
+// BGR to preserve the reference's OpenCV byte order (ImageSchema.scala:18-23).
+//
+// Exposed as a plain C ABI consumed via ctypes (the NativeLoader-equivalent
+// lives in mmlspark_tpu/native_loader.py, cf. NativeLoader.java:29-159).
+
+#include <csetjmp>
+#include <cstdio>
+#include <cstring>
+
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  JpegErrorMgr* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+void jpeg_silence(j_common_ptr, int) {}
+
+bool is_jpeg(const unsigned char* buf, long len) {
+  return len >= 3 && buf[0] == 0xFF && buf[1] == 0xD8 && buf[2] == 0xFF;
+}
+
+bool is_png(const unsigned char* buf, long len) {
+  return len >= 8 && png_sig_cmp(buf, 0, 8) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Probe dimensions. Returns 0 on success, fills (width, height, channels);
+// channels is what decode_image will produce (3 = BGR, 1 = gray).
+int image_dims(const unsigned char* buf, long len, int* width, int* height,
+               int* channels) {
+  if (is_jpeg(buf, len)) {
+    jpeg_decompress_struct cinfo;
+    JpegErrorMgr jerr;
+    cinfo.err = jpeg_std_error(&jerr.pub);
+    jerr.pub.error_exit = jpeg_error_exit;
+    jerr.pub.emit_message = jpeg_silence;
+    if (setjmp(jerr.setjmp_buffer)) {
+      jpeg_destroy_decompress(&cinfo);
+      return -1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+                 static_cast<unsigned long>(len));
+    jpeg_read_header(&cinfo, TRUE);
+    *width = static_cast<int>(cinfo.image_width);
+    *height = static_cast<int>(cinfo.image_height);
+    *channels = cinfo.num_components == 1 ? 1 : 3;
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  if (is_png(buf, len)) {
+    png_image image;
+    memset(&image, 0, sizeof image);
+    image.version = PNG_IMAGE_VERSION;
+    if (!png_image_begin_read_from_memory(&image, buf,
+                                          static_cast<size_t>(len))) {
+      return -1;
+    }
+    *width = static_cast<int>(image.width);
+    *height = static_cast<int>(image.height);
+    *channels = (image.format & PNG_FORMAT_FLAG_COLOR) ? 3 : 1;
+    png_image_free(&image);
+    return 0;
+  }
+  return -2;  // unknown format
+}
+
+// Decode into caller-allocated out (height*width*channels bytes, BGR or
+// gray row-major). Returns 0 on success.
+int decode_image(const unsigned char* buf, long len, unsigned char* out,
+                 int width, int height, int channels) {
+  if (is_jpeg(buf, len)) {
+    jpeg_decompress_struct cinfo;
+    JpegErrorMgr jerr;
+    cinfo.err = jpeg_std_error(&jerr.pub);
+    jerr.pub.error_exit = jpeg_error_exit;
+    jerr.pub.emit_message = jpeg_silence;
+    if (setjmp(jerr.setjmp_buffer)) {
+      jpeg_destroy_decompress(&cinfo);
+      return -1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+                 static_cast<unsigned long>(len));
+    jpeg_read_header(&cinfo, TRUE);
+    cinfo.out_color_space = channels == 1 ? JCS_GRAYSCALE : JCS_RGB;
+    jpeg_start_decompress(&cinfo);
+    if (static_cast<int>(cinfo.output_width) != width ||
+        static_cast<int>(cinfo.output_height) != height) {
+      jpeg_destroy_decompress(&cinfo);
+      return -3;
+    }
+    const int row_bytes = width * channels;
+    while (cinfo.output_scanline < cinfo.output_height) {
+      unsigned char* row = out +
+          static_cast<long>(cinfo.output_scanline) * row_bytes;
+      jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    if (channels == 3) {  // RGB -> BGR in place
+      const long n = static_cast<long>(width) * height;
+      for (long i = 0; i < n; ++i) {
+        unsigned char t = out[i * 3];
+        out[i * 3] = out[i * 3 + 2];
+        out[i * 3 + 2] = t;
+      }
+    }
+    return 0;
+  }
+  if (is_png(buf, len)) {
+    png_image image;
+    memset(&image, 0, sizeof image);
+    image.version = PNG_IMAGE_VERSION;
+    if (!png_image_begin_read_from_memory(&image, buf,
+                                          static_cast<size_t>(len))) {
+      return -1;
+    }
+    image.format = channels == 1 ? PNG_FORMAT_GRAY : PNG_FORMAT_BGR;
+    if (static_cast<int>(image.width) != width ||
+        static_cast<int>(image.height) != height) {
+      png_image_free(&image);
+      return -3;
+    }
+    if (!png_image_finish_read(&image, nullptr, out, 0, nullptr)) {
+      png_image_free(&image);
+      return -1;
+    }
+    return 0;
+  }
+  return -2;
+}
+
+}  // extern "C"

@@ -1,0 +1,106 @@
+"""Native library build + load: the NativeLoader equivalent.
+
+The reference extracts prebuilt .so files from jar resources per executor
+(NativeLoader.java:29-159, loaded per partition via
+ImageSchema.loadLibraryForAllPartitions).  Here the C++ decoder ships as
+source and is compiled once per host into a cache directory, then loaded
+with ctypes — one process-wide load, no per-partition dance.  If the
+toolchain or codec libraries are missing, callers fall back to PIL
+(io/image_reader.py), so the framework degrades instead of failing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SOURCES = ["decode.cpp"]
+_LINK_LIBS = ["-ljpeg", "-lpng"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("MMLSPARK_TPU_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "mmlspark_tpu", "native")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for src in _SOURCES:
+        with open(os.path.join(_NATIVE_DIR, src), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def build_native() -> str:
+    """Compile the decoder if needed; returns the .so path."""
+    so_path = os.path.join(_cache_dir(), f"libmmldecode-{_source_digest()}.so")
+    if os.path.exists(so_path):
+        return so_path
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", so_path + ".tmp",
+           *srcs, *_LINK_LIBS]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(so_path + ".tmp", so_path)
+    return so_path
+
+
+def get_native_lib() -> Optional[ctypes.CDLL]:
+    """The loaded decoder library, or None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            lib = ctypes.CDLL(build_native())
+            lib.image_dims.restype = ctypes.c_int
+            lib.image_dims.argtypes = [
+                ctypes.c_char_p, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int)]
+            lib.decode_image.restype = ctypes.c_int
+            lib.decode_image.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_void_p,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int]
+            _lib = lib
+        except Exception:
+            _load_failed = True
+    return _lib
+
+
+def native_decode(data: bytes) -> Optional[np.ndarray]:
+    """Decode JPEG/PNG bytes to an (H, W, C) BGR/gray uint8 array.
+
+    Returns None when the buffer is not decodable (the reference's decode
+    returns Option, ImageReader.scala:25-40) or the native lib is absent.
+    """
+    lib = get_native_lib()
+    if lib is None:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    c = ctypes.c_int()
+    if lib.image_dims(data, len(data), ctypes.byref(w), ctypes.byref(h),
+                      ctypes.byref(c)) != 0:
+        return None
+    out = np.empty((h.value, w.value, c.value), np.uint8)
+    rc = lib.decode_image(data, len(data),
+                          out.ctypes.data_as(ctypes.c_void_p),
+                          w.value, h.value, c.value)
+    if rc != 0:
+        return None
+    return out

@@ -1,0 +1,5 @@
+"""Batched device kernels (image ops, attention)."""
+
+from mmlspark_tpu.ops import image
+
+__all__ = ["image"]

@@ -1,0 +1,6 @@
+"""Vision pipeline stages (reference image-transformer/, image-featurizer/)."""
+
+from mmlspark_tpu.vision.transformer import ImageTransformer, UnrollImage
+from mmlspark_tpu.vision.featurizer import ImageFeaturizer
+
+__all__ = ["ImageTransformer", "UnrollImage", "ImageFeaturizer"]

@@ -1,0 +1,209 @@
+"""Image stack tests (reference readers/, image-transformer/,
+image-featurizer/, ImageTransformerSuite, ImageReaderSuite)."""
+
+import io
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataTable
+from mmlspark_tpu.core.pipeline import load_stage
+from mmlspark_tpu.io import read_binary_files, read_images
+from mmlspark_tpu.ops import image as ops
+from mmlspark_tpu.vision import ImageFeaturizer, ImageTransformer, UnrollImage
+
+
+def _png_bytes(arr: np.ndarray) -> bytes:
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "PNG")
+    return buf.getvalue()
+
+
+@pytest.fixture
+def image_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        arr = rng.integers(0, 255, size=(32, 48, 3), dtype=np.uint8)
+        (tmp_path / f"img{i}.png").write_bytes(_png_bytes(arr))
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "img4.png").write_bytes(
+        _png_bytes(rng.integers(0, 255, size=(16, 16, 3), dtype=np.uint8)))
+    (tmp_path / "notes.txt").write_bytes(b"not an image")
+    return tmp_path
+
+
+# --------------------------------------------------------------- readers ---
+
+def test_read_binary_files(image_dir):
+    t = read_binary_files(str(image_dir))
+    assert t.num_rows == 5  # 4 images + txt, non-recursive
+    assert t.meta("bytes").binary is not None
+
+
+def test_read_binary_recursive_and_pattern(image_dir):
+    t = read_binary_files(str(image_dir), recursive=True, pattern="*.png")
+    assert t.num_rows == 5
+
+
+def test_read_binary_zip(tmp_path):
+    rng = np.random.default_rng(1)
+    zpath = tmp_path / "bundle.zip"
+    with zipfile.ZipFile(zpath, "w") as zf:
+        for i in range(3):
+            arr = rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+            zf.writestr(f"inner{i}.png", _png_bytes(arr))
+    t = read_binary_files(str(tmp_path))
+    assert t.num_rows == 3
+    assert all("bundle.zip/" in p for p in t["path"])
+
+
+def test_sample_ratio(image_dir):
+    counts = [read_binary_files(str(image_dir), sample_ratio=0.5,
+                                seed=s).num_rows for s in range(8)]
+    assert 0 < np.mean(counts) < 5
+
+
+def test_read_images_uniform_batch(image_dir):
+    t = read_images(str(image_dir))  # txt dropped, only 32x48 batch
+    assert t["image"].shape == (4, 32, 48, 3)
+    assert t["image"].dtype == np.uint8
+    assert t.meta("image").image.height == 32
+
+
+def test_read_images_ragged_and_resize(image_dir):
+    ragged = read_images(str(image_dir), recursive=True)
+    assert ragged["image"].dtype == object  # two shapes
+    resized = read_images(str(image_dir), recursive=True, resize_to=(24, 24))
+    assert resized["image"].shape == (5, 24, 24, 3)
+
+
+def test_read_images_failure_modes(image_dir):
+    with pytest.raises(ValueError):
+        read_images(str(image_dir), drop_failures=False)
+
+
+# ------------------------------------------------------------- image ops ---
+
+def test_resize_and_crop():
+    x = np.zeros((2, 10, 10, 3), np.float32)
+    x[:, :5] = 100.0
+    out = np.asarray(ops.resize(x, 20, 20))
+    assert out.shape == (2, 20, 20, 3)
+    assert out[0, 0, 0, 0] == pytest.approx(100.0)
+    c = np.asarray(ops.crop(x, 2, 1, 4, 5))
+    assert c.shape == (2, 4, 5, 3)
+
+
+def test_cvt_color_gray_matches_opencv_weights():
+    x = np.zeros((1, 2, 2, 3), np.float32)
+    x[..., 0] = 100  # B
+    x[..., 1] = 150  # G
+    x[..., 2] = 200  # R
+    g = np.asarray(ops.cvt_color(x, "bgr2gray"))
+    expected = 0.114 * 100 + 0.587 * 150 + 0.299 * 200
+    assert g.shape == (1, 2, 2, 1)
+    assert g[0, 0, 0, 0] == pytest.approx(expected, rel=1e-5)
+    rgb = np.asarray(ops.cvt_color(x, "bgr2rgb"))
+    assert rgb[0, 0, 0, 0] == 200
+
+
+def test_blur_uniform_region():
+    x = np.full((1, 8, 8, 1), 7.0, np.float32)
+    out = np.asarray(ops.blur(x, 3, 3))
+    assert np.allclose(out, 7.0, atol=1e-5)  # mean-of-valid edges
+
+
+def test_threshold_kinds():
+    x = np.asarray([[0.0, 100.0, 200.0]], np.float32).reshape(1, 1, 3, 1)
+    b = np.asarray(ops.threshold(x, 150.0, 255.0, "binary")).ravel()
+    assert list(b) == [0, 0, 255]
+    t = np.asarray(ops.threshold(x, 150.0, 255.0, "trunc")).ravel()
+    assert list(t) == [0, 100, 150]
+    z = np.asarray(ops.threshold(x, 150.0, 255.0, "tozero")).ravel()
+    assert list(z) == [0, 0, 200]
+
+
+def test_gaussian_kernel_normalized():
+    k = ops.gaussian_kernel_1d(5, 1.0)
+    assert k.sum() == pytest.approx(1.0, abs=1e-6)
+    assert k[2] == k.max()
+    x = np.full((1, 9, 9, 3), 10.0, np.float32)
+    out = np.asarray(ops.gaussian_kernel(x, 5, 1.0))
+    assert out.shape == x.shape
+    assert out[0, 4, 4, 0] == pytest.approx(10.0, rel=1e-4)
+
+
+def test_unroll_chw_order():
+    x = np.zeros((1, 2, 2, 3), np.uint8)
+    x[0, :, :, 0] = 1  # channel 0 everywhere
+    x[0, 0, 0, 1] = 9
+    flat = np.asarray(ops.unroll(x))
+    assert flat.shape == (1, 12)
+    assert (flat[0, :4] == 1).all()      # CHW: channel 0 first
+    assert flat[0, 4] == 9               # then channel 1, row 0, col 0
+
+
+# ------------------------------------------------------ image transformer ---
+
+def test_image_transformer_chain():
+    rng = np.random.default_rng(2)
+    imgs = rng.integers(0, 255, size=(3, 16, 20, 3), dtype=np.uint8)
+    t = DataTable({"image": imgs})
+    it = (ImageTransformer(inputCol="image", outputCol="out")
+          .resize(8, 8).color_format("bgr2gray"))
+    out = it.transform(t)
+    assert out["out"].shape == (3, 8, 8, 1)
+    assert out.meta("out").image.height == 8
+
+
+def test_image_transformer_ragged():
+    rng = np.random.default_rng(3)
+    imgs = [rng.integers(0, 255, size=(h, 10, 3), dtype=np.uint8)
+            for h in (8, 12, 8)]
+    t = DataTable({"image": imgs})
+    it = ImageTransformer().resize(6, 6)
+    out = it.transform(t)
+    assert out["image"].shape == (3, 6, 6, 3)  # uniform after resize
+
+
+def test_image_transformer_save_load(tmp_path):
+    it = (ImageTransformer(inputCol="image", outputCol="out")
+          .resize(4, 4).threshold(100.0, 255.0))
+    it.save(str(tmp_path / "it"))
+    loaded = load_stage(str(tmp_path / "it"))
+    imgs = np.full((2, 8, 8, 3), 160, np.uint8)
+    out = loaded.transform(DataTable({"image": imgs}))
+    assert (np.asarray(out["out"]) == 255.0).all()
+
+
+def test_unroll_image_stage():
+    imgs = np.ones((2, 4, 4, 3), np.uint8)
+    out = UnrollImage(inputCol="image").transform(DataTable({"image": imgs}))
+    assert out["unrolled"].shape == (2, 48)
+
+
+# ------------------------------------------------------- image featurizer ---
+
+def test_image_featurizer_cut_layers():
+    from mmlspark_tpu.models import ConvNetCIFAR10, ModelBundle
+    module = ConvNetCIFAR10(widths=(8, 8, 16), dense_width=32)
+    bundle = ModelBundle.init(module, (1, 32, 32, 3), seed=0,
+                              metadata={"input_shape": [1, 32, 32, 3],
+                                        "layer_names": ["z", "dense1"]})
+    rng = np.random.default_rng(4)
+    imgs = rng.integers(0, 255, size=(6, 64, 64, 3), dtype=np.uint8)
+    t = DataTable({"image": imgs})
+
+    feats = ImageFeaturizer(bundle, inputCol="image",
+                            outputCol="feats").transform(t)
+    assert feats["feats"].shape == (6, 32)  # dense1 activations
+    logits = ImageFeaturizer(bundle, inputCol="image", outputCol="z",
+                             cutOutputLayers=0).transform(t)
+    assert logits["z"].shape == (6, 10)
+    named = ImageFeaturizer(bundle, inputCol="image", outputCol="p3",
+                            layerName="pool3").transform(t)
+    assert named["p3"].shape[0] == 6 and named["p3"].ndim == 4

@@ -26,7 +26,8 @@ def list_files(path: str, recursive: bool = False,
     """Enumerate files under `path` (a file, directory, or glob pattern)."""
     if any(ch in path for ch in "*?["):
         import glob
-        return sorted(glob.glob(path, recursive=recursive))
+        return sorted(p for p in glob.glob(path, recursive=recursive)
+                      if os.path.isfile(p))
     if os.path.isfile(path):
         return [path]
     out: list[str] = []

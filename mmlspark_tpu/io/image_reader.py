@@ -69,19 +69,25 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
         paths.append(p)
 
     if resize_to is not None and images:
-        import jax
         from mmlspark_tpu.ops.image import resize
         h, w = resize_to
-        resized = []
+        # the dense-tensor contract needs one channel count too: widen
+        # gray to 3 channels when the set is mixed (OpenCV imdecode's
+        # default always-BGR behavior)
+        n_channels = {img.shape[2] for img in images}
+        if len(n_channels) > 1:
+            images = [np.repeat(img, 3, axis=2) if img.shape[2] == 1 else img
+                      for img in images]
         # group by source shape so each shape compiles once and the whole
         # group resizes in one batched device dispatch
         by_shape: dict[tuple, list[int]] = {}
         for i, img in enumerate(images):
             by_shape.setdefault(img.shape, []).append(i)
-        resized = [None] * len(images)
+        resized: list = [None] * len(images)
         for shape, idxs in by_shape.items():
             batch = np.stack([images[i] for i in idxs])
-            out = np.asarray(resize(batch, h, w)).astype(np.uint8)
+            out = np.clip(np.rint(np.asarray(resize(batch, h, w))),
+                          0, 255).astype(np.uint8)
             for j, i in enumerate(idxs):
                 resized[i] = out[j]
         images = resized

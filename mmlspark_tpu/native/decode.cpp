@@ -140,6 +140,10 @@ int decode_image(const unsigned char* buf, long len, unsigned char* out,
       png_image_free(&image);
       return -3;
     }
+    // Alpha channels composite against the existing buffer contents when no
+    // background is given; zero it so transparent regions are black, not
+    // whatever the caller's uninitialized allocation held.
+    memset(out, 0, static_cast<size_t>(width) * height * channels);
     if (!png_image_finish_read(&image, nullptr, out, 0, nullptr)) {
       png_image_free(&image);
       return -1;

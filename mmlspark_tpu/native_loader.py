@@ -50,10 +50,10 @@ def build_native() -> str:
     if os.path.exists(so_path):
         return so_path
     srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", so_path + ".tmp",
-           *srcs, *_LINK_LIBS]
+    tmp = f"{so_path}.{os.getpid()}.tmp"  # per-process: concurrent builds race
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, *srcs, *_LINK_LIBS]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(so_path + ".tmp", so_path)
+    os.replace(tmp, so_path)
     return so_path
 
 

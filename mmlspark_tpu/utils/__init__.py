@@ -1,0 +1,7 @@
+"""Cross-cutting utilities: stage registry, random data generation."""
+
+from mmlspark_tpu.utils.registry import all_stage_classes, api_summary
+from mmlspark_tpu.utils.datagen import ColumnOptions, generate_table
+
+__all__ = ["all_stage_classes", "api_summary", "generate_table",
+           "ColumnOptions"]

@@ -1,0 +1,80 @@
+"""Stage discovery: reflection over the package.
+
+TPU-native counterpart of the reference's JarLoadingUtils
+(JarLoadingUtils.scala:115-137): where the reference scans built jars for
+every Transformer/Estimator/MLReadable to drive fuzzing and PySpark
+wrapper codegen, here a package walk imports every module under
+mmlspark_tpu and collects the PipelineStage subclasses.  The same registry
+powers the fuzzing suite (tests/test_fuzzing.py) and the generated API
+reference (api_summary — the codegen role collapses to introspection since
+the core is already Python, SURVEY §7 stage 7).
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pkgutil
+
+import mmlspark_tpu
+from mmlspark_tpu.core.pipeline import Estimator, PipelineStage, Transformer
+
+_SKIP_MODULES = ("mmlspark_tpu.native_loader",)
+
+
+def _walk_modules():
+    for info in pkgutil.walk_packages(mmlspark_tpu.__path__,
+                                      prefix="mmlspark_tpu."):
+        if info.name in _SKIP_MODULES:
+            continue
+        yield importlib.import_module(info.name)
+
+
+def all_stage_classes(concrete_only: bool = True) -> list[type]:
+    """Every PipelineStage subclass defined in the package."""
+    seen: dict[str, type] = {}
+    for module in _walk_modules():
+        for _, obj in inspect.getmembers(module, inspect.isclass):
+            if not issubclass(obj, PipelineStage):
+                continue
+            if not obj.__module__.startswith("mmlspark_tpu"):
+                continue
+            key = f"{obj.__module__}.{obj.__qualname__}"
+            seen[key] = obj
+    out = []
+    for cls in seen.values():
+        if concrete_only:
+            if inspect.isabstract(cls):
+                continue
+            # base plumbing classes are not user stages
+            if cls.__module__ == "mmlspark_tpu.core.pipeline":
+                continue
+        out.append(cls)
+    return sorted(out, key=lambda c: f"{c.__module__}.{c.__qualname__}")
+
+
+def api_summary() -> str:
+    """Markdown API reference generated from the registry + param docs
+    (the PySparkWrapperGenerator's documentation role,
+    PySparkWrapperGenerator.scala:34-91)."""
+    lines = ["# mmlspark_tpu API reference", ""]
+    for cls in all_stage_classes():
+        kind = ("Estimator" if issubclass(cls, Estimator)
+                else "Transformer" if issubclass(cls, Transformer)
+                else "PipelineStage")
+        lines.append(f"## {cls.__qualname__} ({kind})")
+        lines.append(f"`{cls.__module__}`")
+        doc = inspect.getdoc(cls)
+        if doc:
+            lines.append("")
+            lines.append(doc.split("\n\n")[0])
+        params = cls.params()
+        if params:
+            lines.append("")
+            lines.append("| param | default | doc |")
+            lines.append("|---|---|---|")
+            for name, p in sorted(params.items()):
+                default = repr(p.default) if p.has_default else "(required)"
+                lines.append(f"| `{name}` | `{default}` | {p.doc} |")
+        lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,19 @@
+"""Model zoo: repository client with integrity-checked downloads
+(reference downloader/)."""
+
+from mmlspark_tpu.zoo.downloader import (
+    LocalRepo,
+    ModelDownloader,
+    ModelNotFoundError,
+    ModelSchema,
+    RemoteRepo,
+    create_builtin_repo,
+    pack_bundle,
+    unpack_bundle,
+)
+
+__all__ = [
+    "ModelSchema", "ModelDownloader", "LocalRepo", "RemoteRepo",
+    "ModelNotFoundError", "create_builtin_repo", "pack_bundle",
+    "unpack_bundle",
+]

@@ -1,0 +1,276 @@
+"""Model repository client: manifest -> sha256-verified local cache.
+
+TPU-native counterpart of the reference's downloader
+(ModelDownloader.scala:24-242, Schema.scala:20-96): repositories list
+`.meta` JSON schemas describing models (name, dataset, type, uri, sha256,
+size, layer names); downloading copies the payload into a local repo,
+verifies the hash (Schema.scala:35-41), writes the updated `.meta`, and
+skips models already cached with a matching hash
+(ModelDownloader.scala:169-181).
+
+The payload format is a `.tpubundle` zip of a ModelBundle directory
+(models/bundle.py) instead of an opaque CNTK graph file; `layer_names` and
+`input_shape` ride in the bundle metadata so ImageFeaturizer can cut heads
+without probing the graph over JNI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import shutil
+import tempfile
+import zipfile
+from typing import Iterable, Optional
+
+from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
+
+
+class ModelNotFoundError(FileNotFoundError):
+    """Reference ModelNotFoundException (ModelDownloader.scala:36-40)."""
+
+
+@dataclasses.dataclass
+class ModelSchema:
+    """Reference ModelSchema (Schema.scala:56-76)."""
+
+    name: str
+    dataset: str
+    modelType: str
+    uri: str
+    hash: str
+    size: int
+    inputShape: Optional[list] = None
+    numLayers: int = 0
+    layerNames: list = dataclasses.field(default_factory=list)
+
+    @property
+    def filename(self) -> str:
+        return f"{self.name}_{self.dataset}.tpubundle"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "ModelSchema":
+        return ModelSchema(**d)
+
+
+def sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# --------------------------------------------------------------------------
+# bundle <-> single-file payload
+# --------------------------------------------------------------------------
+
+def pack_bundle(bundle_dir: str, out_path: str) -> str:
+    """Zip a bundle directory deterministically (sorted names, zeroed
+    timestamps) so equal bundles hash equal."""
+    with zipfile.ZipFile(out_path, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _, names in sorted(os.walk(bundle_dir)):
+            for name in sorted(names):
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, bundle_dir)
+                info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+                info.compress_type = zipfile.ZIP_DEFLATED
+                with open(full, "rb") as f:
+                    zf.writestr(info, f.read())
+    return out_path
+
+
+def unpack_bundle(payload_path: str, out_dir: str) -> str:
+    with zipfile.ZipFile(payload_path) as zf:
+        zf.extractall(out_dir)
+    return out_dir
+
+
+# --------------------------------------------------------------------------
+# repositories
+# --------------------------------------------------------------------------
+
+class LocalRepo:
+    """Directory of .tpubundle payloads + .meta JSON schemas
+    (the HDFSRepo analogue, ModelDownloader.scala:43-106)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def list_schemas(self) -> Iterable[ModelSchema]:
+        out = []
+        for name in sorted(os.listdir(self.path)):
+            if name.endswith(".meta"):
+                with open(os.path.join(self.path, name)) as f:
+                    out.append(ModelSchema.from_json(json.load(f)))
+        return out
+
+    def get_payload(self, schema: ModelSchema) -> bytes:
+        path = schema.uri
+        if not os.path.exists(path):
+            raise ModelNotFoundError(path)
+        with open(path, "rb") as f:
+            return f.read()
+
+    def add_model(self, bundle: ModelBundle, name: str, dataset: str,
+                  model_type: str = "image") -> ModelSchema:
+        """Publish a bundle into this repo (addBytes analogue)."""
+        with tempfile.TemporaryDirectory() as tmp:
+            bdir = os.path.join(tmp, "bundle")
+            save_bundle(bundle, bdir)
+            payload = os.path.join(self.path, f"{name}_{dataset}.tpubundle")
+            pack_bundle(bdir, payload)
+        meta = bundle.metadata or {}
+        schema = ModelSchema(
+            name=name, dataset=dataset, modelType=model_type,
+            uri=payload, hash=sha256_file(payload),
+            size=os.path.getsize(payload),
+            inputShape=meta.get("input_shape"),
+            numLayers=len(meta.get("layer_names", [])),
+            layerNames=list(meta.get("layer_names", [])))
+        with open(payload + ".meta", "w") as f:
+            json.dump(schema.to_json(), f, indent=1)
+        return schema
+
+
+class RemoteRepo:
+    """HTTP(S) repository: MANIFEST lists .meta names
+    (the DefaultModelRepo analogue, ModelDownloader.scala:109-157)."""
+
+    def __init__(self, base_url: str, connect_timeout: float = 15.0,
+                 read_timeout: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        self.connect_timeout = connect_timeout
+        self.read_timeout = read_timeout
+
+    def _fetch(self, rel: str, timeout: Optional[float] = None) -> bytes:
+        import urllib.request
+        url = f"{self.base_url}/{rel}"
+        with urllib.request.urlopen(
+                url, timeout=timeout or self.connect_timeout) as r:
+            return r.read()
+
+    def list_schemas(self) -> Iterable[ModelSchema]:
+        manifest = self._fetch("MANIFEST").decode().split()
+        out = []
+        for meta_name in manifest:
+            d = json.loads(self._fetch(meta_name).decode())
+            out.append(ModelSchema.from_json(d))
+        return out
+
+    def get_payload(self, schema: ModelSchema) -> bytes:
+        uri = schema.uri
+        rel = uri if "://" not in uri else uri.rsplit("/", 1)[-1]
+        try:
+            # large payloads get the (longer) read window
+            return self._fetch(rel, timeout=self.read_timeout)
+        except Exception as e:
+            raise ModelNotFoundError(uri) from e
+
+
+# --------------------------------------------------------------------------
+# the downloader
+# --------------------------------------------------------------------------
+
+class ModelDownloader:
+    """Sync models from a repo into a local cache, verified by sha256."""
+
+    def __init__(self, local_path: Optional[str] = None):
+        self.local = LocalRepo(local_path or os.path.join(
+            os.path.expanduser("~"), ".cache", "mmlspark_tpu", "models"))
+
+    def local_models(self) -> list[ModelSchema]:
+        return list(self.local.list_schemas())
+
+    def remote_models(self, repo) -> list[ModelSchema]:
+        return list(repo.list_schemas())
+
+    def download_model(self, repo, schema: ModelSchema,
+                       always_download: bool = False) -> ModelSchema:
+        """Fetch + verify one model; returns the localized schema.
+
+        Skips the fetch when a cached copy with the same hash exists
+        (ModelDownloader.scala:169-181).
+        """
+        target = os.path.join(self.local.path, schema.filename)
+        if (not always_download and os.path.exists(target)
+                and sha256_file(target) == schema.hash):
+            return self._localized(schema, target)
+        data = repo.get_payload(schema)
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != schema.hash:
+            raise ValueError(
+                f"downloaded hash {digest} does not match schema hash "
+                f"{schema.hash} for model {schema.name} (Schema.scala:35-41)")
+        tmp = target + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, target)
+        local_schema = self._localized(schema, target)
+        with open(target + ".meta", "w") as f:
+            json.dump(local_schema.to_json(), f, indent=1)
+        return local_schema
+
+    def download_by_name(self, repo, name: str,
+                         always_download: bool = False) -> ModelSchema:
+        """ModelDownloader.downloadByName (scala:236-242)."""
+        for schema in repo.list_schemas():
+            if schema.name == name:
+                return self.download_model(repo, schema, always_download)
+        raise ModelNotFoundError(name)
+
+    def load_bundle(self, schema: ModelSchema) -> ModelBundle:
+        """Unpack a localized schema's payload into a ModelBundle."""
+        with tempfile.TemporaryDirectory() as tmp:
+            unpack_bundle(schema.uri, tmp)
+            return load_bundle(tmp)
+
+    @staticmethod
+    def _localized(schema: ModelSchema, target: str) -> ModelSchema:
+        return dataclasses.replace(schema, uri=target)
+
+
+# --------------------------------------------------------------------------
+# built-in zoo
+# --------------------------------------------------------------------------
+
+_BUILTIN_SPECS = [
+    # (name, dataset, architecture, config, input_shape, layer_names)
+    ("ConvNet", "CIFAR10", "ConvNetCIFAR10", {},
+     [1, 32, 32, 3], ["z", "dense1", "pool3", "pool2", "pool1"]),
+    ("ResNet18", "ImageNet", "ResNet",
+     {"stage_sizes": [2, 2, 2, 2], "widths": [64, 128, 256, 512]},
+     [1, 224, 224, 3], ["z", "pool", "stage4", "stage3", "stage2", "stage1"]),
+    ("MLP", "Generic", "MLPClassifier", {"hidden_sizes": [100]},
+     [1, 16], ["z", "h0"]),
+]
+
+
+def create_builtin_repo(path: str, seed: int = 0) -> LocalRepo:
+    """Materialize the built-in architecture zoo as a local repo.
+
+    Weights are seed-initialized (the reference's zoo ships pretrained CNTK
+    graphs from a CDN, tools/config.sh; in an air-gapped build the zoo
+    carries architectures + integrity plumbing, and fine-tuning fills in
+    weights via train/).
+    """
+    from mmlspark_tpu.models.definitions import build_model
+    repo = LocalRepo(path)
+    existing = {(s.name, s.dataset) for s in repo.list_schemas()}
+    for name, dataset, arch, config, input_shape, layer_names in _BUILTIN_SPECS:
+        if (name, dataset) in existing:
+            continue
+        module = build_model(arch, config)
+        bundle = ModelBundle.init(module, tuple(input_shape), seed=seed,
+                                  metadata={"input_shape": input_shape,
+                                            "layer_names": layer_names,
+                                            "pretrained": False})
+        repo.add_model(bundle, name, dataset)
+    return repo

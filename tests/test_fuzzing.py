@@ -1,0 +1,228 @@
+"""Generic stage fuzzing: the reference's cross-cutting invariant suite
+(Fuzzing.scala:18-254) rebuilt on the package registry.
+
+Invariants, per discovered stage:
+  * registry discovers it (JarLoadingUtils analogue);
+  * params have docs and valid identifier names (Fuzzing.scala:106-132);
+  * save/load round-trips params (35-45, 208-234);
+  * fit/transform runs on generated random data (49-104), via per-stage
+    fixtures mirroring EstimatorFuzzingTest/TransformerFuzzingTest
+    overrides (ModuleFuzzingTest.scala:13-52).
+"""
+
+import keyword
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import (Estimator, PipelineStage, Transformer,
+                                        load_stage)
+from mmlspark_tpu.utils import all_stage_classes, api_summary, generate_table
+from mmlspark_tpu.utils.datagen import ColumnOptions
+
+
+# ---------------------------------------------------------------- fixtures ---
+
+def _ml_table(seed=0, n=40):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n)
+    X = (np.stack([y * 3.0 + rng.normal(0, 0.5, n),
+                   -y * 2.0 + rng.normal(0, 0.5, n)], axis=1)).astype(np.float32)
+    from mmlspark_tpu import DataTable
+    return DataTable({"features": X, "label": y.astype(np.int64)})
+
+
+def _image_table(seed=0, n=3):
+    from mmlspark_tpu import DataTable
+    rng = np.random.default_rng(seed)
+    return DataTable({"image": rng.integers(0, 255, size=(n, 8, 8, 3),
+                                            dtype=np.uint8)})
+
+
+def _text_table():
+    from mmlspark_tpu import DataTable
+    return DataTable({"txt": ["alpha beta", "beta gamma delta", "alpha"],
+                      "tokens": [["alpha", "beta"], ["beta"], []]})
+
+
+def _tiny_bundle():
+    from mmlspark_tpu.models import MLPClassifier, ModelBundle
+    return ModelBundle.init(MLPClassifier(hidden_sizes=(4,), num_classes=2),
+                            (1, 2), seed=0)
+
+
+# stage-name -> () -> (instance, table or None)
+def _fixtures():
+    from mmlspark_tpu import Pipeline
+    from mmlspark_tpu.feature import (AssembleFeatures, Featurize, HashingTF,
+                                      IDF, NGram, StopWordsRemover,
+                                      TextFeaturizer, Tokenizer)
+    from mmlspark_tpu.ml import (ComputeModelStatistics,
+                                 ComputePerInstanceStatistics, FindBestModel,
+                                 LinearRegression, LogisticRegression,
+                                 MultilayerPerceptronClassifier, NaiveBayes,
+                                 OneVsRest, TrainClassifier, TrainRegressor)
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    from mmlspark_tpu.train import TrainerConfig
+    from mmlspark_tpu.train.learner import TPULearner
+    from mmlspark_tpu.stages import (CheckpointData, DataConversion,
+                                     DropColumns, MultiColumnAdapter,
+                                     PartitionSample, RenameColumns,
+                                     Repartition, SelectColumns,
+                                     SummarizeData)
+    from mmlspark_tpu.vision import (ImageFeaturizer, ImageTransformer,
+                                     UnrollImage)
+
+    gen = generate_table(num_rows=20, seed=0)
+    ml = _ml_table()
+    txt = _text_table()
+    img = _image_table()
+
+    return {
+        "SelectColumns": lambda: (SelectColumns(cols=["double_0"]), gen),
+        "DropColumns": lambda: (DropColumns(cols=["double_0"]), gen),
+        "RenameColumns": lambda: (RenameColumns(mapping={"double_0": "d"}), gen),
+        "Repartition": lambda: (Repartition(n=2), gen),
+        "CheckpointData": lambda: (CheckpointData(), gen),
+        "DataConversion": lambda: (
+            DataConversion(cols=["int_1"], convertTo="double"), gen),
+        "SummarizeData": lambda: (SummarizeData(), gen),
+        "PartitionSample": lambda: (
+            PartitionSample(mode="Head", count=5), gen),
+        "MultiColumnAdapter": lambda: (
+            MultiColumnAdapter(
+                DataConversion(convertTo="double").copy(),
+                inputCols=[], outputCols=[]), None),
+        "Tokenizer": lambda: (Tokenizer(inputCol="txt"), txt),
+        "StopWordsRemover": lambda: (StopWordsRemover(inputCol="tokens"), txt),
+        "NGram": lambda: (NGram(inputCol="tokens"), txt),
+        "HashingTF": lambda: (
+            HashingTF(inputCol="tokens", numFeatures=64), txt),
+        "IDF": lambda: (
+            IDF(inputCol="tf"),
+            HashingTF(inputCol="tokens", outputCol="tf",
+                      numFeatures=64).transform(txt)),
+        "TextFeaturizer": lambda: (
+            TextFeaturizer(inputCol="txt", numFeatures=64), txt),
+        "AssembleFeatures": lambda: (
+            AssembleFeatures(columnsToFeaturize=["double_0", "int_1"],
+                             numberOfFeatures=64), gen),
+        "Featurize": lambda: (
+            Featurize(featureColumns={"f": ["double_0"]},
+                      numberOfFeatures=64), gen),
+        "LogisticRegression": lambda: (LogisticRegression(), ml),
+        "LinearRegression": lambda: (LinearRegression(), ml),
+        "NaiveBayes": lambda: (
+            NaiveBayes(),
+            ml.with_column("features", np.abs(ml["features"]))),
+        "MultilayerPerceptronClassifier": lambda: (
+            MultilayerPerceptronClassifier(layers=[2, 4, 2], maxIter=2), ml),
+        "OneVsRest": lambda: (OneVsRest(LogisticRegression()), ml),
+        "TrainClassifier": lambda: (
+            TrainClassifier(LogisticRegression(), labelCol="label"),
+            ml.rename({"features": "feats"})),
+        "TrainRegressor": lambda: (
+            TrainRegressor(LinearRegression(), labelCol="label"),
+            ml.rename({"features": "feats"})),
+        "ComputeModelStatistics": lambda: (ComputeModelStatistics(), None),
+        "ComputePerInstanceStatistics": lambda: (
+            ComputePerInstanceStatistics(), None),
+        "FindBestModel": lambda: (FindBestModel(), None),
+        "TPULearner": lambda: (
+            TPULearner(TrainerConfig(
+                architecture="MLPClassifier",
+                model_config={"hidden_sizes": [4], "num_classes": 2,
+                              "dtype": "float32"},
+                epochs=1, batch_size=8, loss="softmax_xent")), ml),
+        "TPUModel": lambda: (
+            TPUModel(_tiny_bundle(), inputCol="features",
+                     miniBatchSize=8), ml),
+        "ImageTransformer": lambda: (
+            ImageTransformer().resize(4, 4), img),
+        "UnrollImage": lambda: (UnrollImage(), img),
+        "ImageFeaturizer": lambda: (ImageFeaturizer(), None),
+        "Pipeline": lambda: (
+            Pipeline([SelectColumns(cols=["double_0", "label"])]), gen),
+    }
+
+
+# model classes that only arise from fit(); their round-trips are covered
+# through their estimators below
+_MODEL_ONLY = {
+    "AssembleFeaturesModel", "PipelineModel", "TextFeaturizerModel",
+    "IDFModel", "LogisticRegressionModel", "LinearRegressionModel",
+    "NaiveBayesModel", "MultilayerPerceptronClassifierModel",
+    "OneVsRestModel", "TrainedClassifierModel", "TrainedRegressorModel",
+    "BestModel", "ClassifierModel", "RegressorModel", "Evaluator",
+}
+
+
+def test_registry_finds_the_surface():
+    names = {c.__qualname__ for c in all_stage_classes()}
+    expected = {"TrainClassifier", "TPUModel", "ImageTransformer",
+                "Featurize", "SummarizeData", "TextFeaturizer",
+                "ComputeModelStatistics", "FindBestModel"}
+    assert expected <= names, expected - names
+    assert len(names) >= 30
+
+
+def test_every_stage_is_fixtured_or_model_only():
+    fixtures = _fixtures()
+    missing = [c.__qualname__ for c in all_stage_classes()
+               if c.__qualname__ not in fixtures
+               and c.__qualname__ not in _MODEL_ONLY]
+    assert not missing, f"stages without fuzzing fixtures: {missing}"
+
+
+def test_param_hygiene():
+    for cls in all_stage_classes(concrete_only=False):
+        for name, p in cls.params().items():
+            assert name.isidentifier() and not keyword.iskeyword(name), \
+                f"{cls.__qualname__}.{name}"
+            assert p.doc, f"{cls.__qualname__}.{name} has no doc"
+            assert p.name == name
+
+
+@pytest.mark.parametrize("stage_name", sorted(_fixtures()))
+def test_save_load_roundtrip(stage_name, tmp_path):
+    stage, _ = _fixtures()[stage_name]()
+    stage.save(str(tmp_path / "s"))
+    loaded = load_stage(str(tmp_path / "s"))
+    assert type(loaded) is type(stage)
+    assert loaded.param_values() == pytest.approx(stage.param_values()) \
+        if all(isinstance(v, (int, float)) for v in stage.param_values().values()) \
+        else loaded.param_values().keys() == stage.param_values().keys()
+    for k, v in stage.param_values().items():
+        lv = loaded.get(k)
+        if isinstance(v, np.ndarray):
+            assert np.array_equal(lv, v)
+        elif isinstance(v, tuple):
+            assert list(lv) == list(v)
+        else:
+            assert lv == v, f"{stage_name}.{k}: {lv!r} != {v!r}"
+
+
+@pytest.mark.parametrize("stage_name", sorted(_fixtures()))
+def test_fit_transform_fuzz(stage_name, tmp_path):
+    stage, table = _fixtures()[stage_name]()
+    if table is None:
+        pytest.skip("stage needs richer context; covered by module tests")
+    if isinstance(stage, Estimator):
+        model = stage.fit(table)
+        assert isinstance(model, Transformer)
+        out = model.transform(table)
+        # fitted models must round-trip too (Fuzzing.scala:208-234)
+        model.save(str(tmp_path / "m"))
+        reloaded = load_stage(str(tmp_path / "m"))
+        out2 = reloaded.transform(table)
+        assert out2.num_rows == out.num_rows
+    else:
+        out = stage.transform(table)
+    assert out.num_rows >= 0
+    assert out.columns
+
+
+def test_api_summary_generates():
+    doc = api_summary()
+    assert "TrainClassifier" in doc and "| param |" in doc
+    assert len(doc) > 2000

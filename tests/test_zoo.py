@@ -1,0 +1,90 @@
+"""Model zoo tests (reference downloader/, DownloaderSuite)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models import MLPClassifier, ModelBundle
+from mmlspark_tpu.zoo import (
+    LocalRepo,
+    ModelDownloader,
+    ModelNotFoundError,
+    ModelSchema,
+    create_builtin_repo,
+)
+
+
+@pytest.fixture
+def source_repo(tmp_path):
+    repo = LocalRepo(str(tmp_path / "source"))
+    module = MLPClassifier(hidden_sizes=(8,), num_classes=3)
+    bundle = ModelBundle.init(module, (1, 5), seed=1,
+                              metadata={"input_shape": [1, 5],
+                                        "layer_names": ["z", "h0"]})
+    repo.add_model(bundle, "TinyMLP", "unit", model_type="generic")
+    return repo
+
+
+def test_publish_and_list(source_repo):
+    schemas = list(source_repo.list_schemas())
+    assert len(schemas) == 1
+    s = schemas[0]
+    assert s.name == "TinyMLP" and s.layerNames == ["z", "h0"]
+    assert s.size > 0 and len(s.hash) == 64
+
+
+def test_download_verifies_and_caches(tmp_path, source_repo):
+    dl = ModelDownloader(str(tmp_path / "cache"))
+    schema = dl.download_by_name(source_repo, "TinyMLP")
+    assert os.path.exists(schema.uri)
+    # cached second download: corrupt the source; cache hit must not refetch
+    src = list(source_repo.list_schemas())[0]
+    with open(src.uri, "ab") as f:
+        f.write(b"corruption")
+    again = dl.download_by_name(source_repo, "TinyMLP")
+    assert again.uri == schema.uri
+    # force re-download now sees the corrupt payload -> hash mismatch
+    with pytest.raises(ValueError, match="hash"):
+        dl.download_model(source_repo, src, always_download=True)
+
+
+def test_download_roundtrip_bundle(tmp_path, source_repo):
+    dl = ModelDownloader(str(tmp_path / "cache"))
+    schema = dl.download_by_name(source_repo, "TinyMLP")
+    bundle = dl.load_bundle(schema)
+    assert bundle.architecture == "MLPClassifier"
+    module = bundle.module()
+    out = module.apply(bundle.variables, np.zeros((2, 5), np.float32))
+    assert out.shape == (2, 3)
+
+
+def test_download_unknown_model(tmp_path, source_repo):
+    dl = ModelDownloader(str(tmp_path / "cache"))
+    with pytest.raises(ModelNotFoundError):
+        dl.download_by_name(source_repo, "DoesNotExist")
+
+
+def test_builtin_repo(tmp_path):
+    repo = create_builtin_repo(str(tmp_path / "zoo"))
+    names = {s.name for s in repo.list_schemas()}
+    assert {"ConvNet", "ResNet18", "MLP"} <= names
+    # idempotent
+    create_builtin_repo(str(tmp_path / "zoo"))
+    assert len(list(repo.list_schemas())) == 3
+
+
+def test_zoo_feeds_image_featurizer(tmp_path):
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.vision import ImageFeaturizer
+    repo = create_builtin_repo(str(tmp_path / "zoo"))
+    dl = ModelDownloader(str(tmp_path / "cache"))
+    schema = dl.download_by_name(repo, "ConvNet")
+    bundle = dl.load_bundle(schema)
+    rng = np.random.default_rng(0)
+    t = DataTable({"image": rng.integers(0, 255, size=(4, 48, 48, 3),
+                                         dtype=np.uint8)})
+    out = ImageFeaturizer(bundle, inputCol="image",
+                          outputCol="feats").transform(t)
+    assert out["feats"].shape == (4, 512)  # dense1 width of ConvNetCIFAR10

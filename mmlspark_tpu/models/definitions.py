@@ -151,6 +151,92 @@ class ResNet(nn.Module, NodeMixin):
         return self.node("z", z.astype(jnp.float32))
 
 
+class TransformerBlock(nn.Module):
+    """Pre-norm decoder block with pluggable attention execution."""
+
+    d_model: int
+    n_heads: int
+    mlp_ratio: int = 4
+    dtype: Dtype = jnp.bfloat16
+    attn_impl: str = "dense"          # dense | ring | ulysses
+    seq_axis: Optional[str] = None    # mesh axis for ring/ulysses
+
+    @nn.compact
+    def __call__(self, x):
+        from mmlspark_tpu.ops.attention import (attention, ring_attention,
+                                                ulysses_attention)
+        b, s, _ = x.shape
+        d_head = self.d_model // self.n_heads
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.d_model, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, s, self.n_heads, d_head)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        if self.attn_impl == "dense":
+            o = attention(q, k, v, causal=True)
+        elif self.attn_impl == "ring":
+            o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        elif self.attn_impl == "ulysses":
+            o = ulysses_attention(q, k, v, axis_name=self.seq_axis,
+                                  causal=True)
+        else:
+            raise ValueError(f"unknown attn_impl '{self.attn_impl}'")
+        x = x + nn.Dense(self.d_model, dtype=self.dtype,
+                         name="proj")(o.reshape(b, s, self.d_model))
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype,
+                     name="mlp_up")(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(self.d_model, dtype=self.dtype,
+                            name="mlp_down")(h)
+
+
+class TransformerLM(nn.Module, NodeMixin):
+    """Decoder-only language model — the long-context flagship.
+
+    New-design headroom over the reference (which has no sequence axis,
+    SURVEY §5): with attn_impl='ring'/'ulysses' and seq_axis set, the model
+    runs under shard_map with its sequence sharded over the mesh
+    (parallel/ring.py), and position embeddings use GLOBAL positions
+    derived from the device's ring index.  Named nodes: embed, block0..N,
+    final_norm, z.
+    """
+
+    vocab_size: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    max_len: int = 2048
+    mlp_ratio: int = 4
+    dtype: Dtype = jnp.bfloat16
+    attn_impl: str = "dense"
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        # tokens: (B, S_local) int — S_local == S unless sequence-sharded
+        s_local = tokens.shape[1]
+        if self.seq_axis is not None and self.attn_impl != "dense":
+            offset = jax.lax.axis_index(self.seq_axis) * s_local
+        else:
+            offset = 0
+        pos = offset + jnp.arange(s_local)
+        tok_emb = nn.Embed(self.vocab_size, self.d_model,
+                           dtype=self.dtype, name="tok_embed")(tokens)
+        pos_emb = nn.Embed(self.max_len, self.d_model,
+                           dtype=self.dtype, name="pos_embed")(pos)
+        x = self.node("embed", tok_emb + pos_emb[None])
+        for i in range(self.n_layers):
+            x = TransformerBlock(
+                self.d_model, self.n_heads, self.mlp_ratio, self.dtype,
+                self.attn_impl, self.seq_axis, name=f"block{i}_w")(x)
+            x = self.node(f"block{i}", x)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm_w")(x)
+        x = self.node("final_norm", x)
+        z = nn.Dense(self.vocab_size, dtype=self.dtype, name="lm_head")(x)
+        return self.node("z", z.astype(jnp.float32))
+
+
 # --------------------------------------------------------------------------
 # Registry — serialized bundles name their architecture; build_model
 # reconstructs it (the analogue of CNTK's self-describing .model files).
@@ -161,6 +247,7 @@ MODEL_REGISTRY: dict[str, Callable[..., nn.Module]] = {
     "LinearModel": LinearModel,
     "ConvNetCIFAR10": ConvNetCIFAR10,
     "ResNet": ResNet,
+    "TransformerLM": TransformerLM,
 }
 
 

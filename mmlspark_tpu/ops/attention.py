@@ -1,0 +1,153 @@
+"""Attention kernels: dense, ring (sequence-parallel), and Ulysses.
+
+Long-context support is new-design headroom over the reference — it has no
+sequence dimension at all (SURVEY §5: its longest input is one image row,
+and the structural seam is the minibatcher, CNTKModel.scala:50-104).  A
+TPU-native framework makes sequence/context parallelism first-class:
+
+  * `attention`        — standard dense multi-head attention (one device's
+                         whole sequence; XLA fuses QK^T -> softmax -> @V).
+  * `ring_attention`   — sequence sharded over a mesh axis; K/V blocks
+                         rotate around the ring via ppermute while each
+                         device accumulates online-softmax partials, so
+                         peak memory is O(S_local) and the permute overlaps
+                         the next block's matmuls.  Call under shard_map.
+  * `ulysses_attention`— all-to-all alternative: swap the seq shard for a
+                         head shard, run dense attention on full sequences
+                         locally, swap back.  Fewer collective steps, needs
+                         heads % axis_size == 0.  Call under shard_map.
+
+Both parallel forms are numerically equivalent to `attention` (tested on a
+virtual 8-device mesh, tests/test_seq_parallel.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = False,
+              scale: Optional[float] = None,
+              q_offset=0) -> jax.Array:
+    """Dense multi-head attention.
+
+    q, k, v: (B, S, H, D) -> (B, S, H, D).  bfloat16-friendly: softmax
+    statistics stay in float32.  `q_offset` shifts the queries' global
+    positions for causal masking when q is a slice of a longer sequence
+    (the all-gather sequence-parallel fallback).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        q_len, k_len = s.shape[-2], s.shape[-1]
+        q_pos = q_offset + jnp.arange(q_len)
+        mask = q_pos[:, None] >= jnp.arange(k_len)[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _block_scores(q, k, scale):
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over a sharded sequence axis (must run under
+    shard_map with `axis_name` in scope).
+
+    q, k, v: (B, S_local, H, D), the local sequence shard.  Each ring step
+    computes this device's queries against the currently-held K/V block,
+    folds the result into online-softmax accumulators (running max M,
+    normalizer L, weighted sum ACC), then rotates K/V one hop around the
+    ring with ppermute.  After axis_size steps every query has seen every
+    key.  Causal masking uses global positions derived from the block's
+    origin device.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale_ = scale if scale is not None else d ** -0.5
+
+    q_pos = my_idx * s_local + jnp.arange(s_local)          # global q positions
+
+    # derive initial accumulators from q so they carry the same
+    # varying-manual-axes type as the loop outputs (shard_map scan rule)
+    acc0 = (q * 0).astype(jnp.float32)                       # (B,Sq,H,D)
+    zero_bhs = (q[..., 0] * 0).astype(jnp.float32).transpose(0, 2, 1)
+    m0 = zero_bhs + NEG_INF                                  # (B,H,Sq)
+    l0 = zero_bhs
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def fold(i, k_cur, v_cur, acc, m, l):
+        """Fold one K/V block into the online-softmax accumulators."""
+        # the block held at step i originated on device (my_idx - i) mod n
+        src = (my_idx - i) % axis_size
+        s_scores = _block_scores(q, k_cur, scale_)           # (B,H,Sq,Sk)
+        if causal:
+            k_pos = src * s_local + jnp.arange(s_local)
+            mask = q_pos[:, None] >= k_pos[None, :]          # (Sq, Sk)
+            s_scores = jnp.where(mask[None, None], s_scores, NEG_INF)
+        blk_max = s_scores.max(axis=-1)                      # (B,H,Sq)
+        m_new = jnp.maximum(m, blk_max)
+        # guard fully-masked blocks: exp(NEG_INF - NEG_INF) would be 1
+        safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(s_scores - safe_m[..., None])
+        p = jnp.where(s_scores == NEG_INF, 0.0, p)
+        correction = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - safe_m))
+        l_new = l * correction + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cur.dtype),
+                        v_cur).astype(jnp.float32)
+        acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+        return acc_new, m_new, l_new
+
+    def step(i, carry):
+        k_cur, v_cur, acc, m, l = carry
+        acc, m, l = fold(i, k_cur, v_cur, acc, m, l)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, acc, m, l
+
+    # N-1 fold+rotate steps, then a final fold with no trailing ppermute
+    # (the last rotation's result would never be read — wasted ICI hops)
+    k_last, v_last, acc, m, l = jax.lax.fori_loop(
+        0, axis_size - 1, step, (k, v, acc0, m0, l0))
+    acc, _, l = fold(axis_size - 1, k_last, v_last, acc, m, l)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style), under
+    shard_map.
+
+    Input shards are (B, S_local, H, D); the all_to_all regroups to
+    (B, S_full, H_local, D) — full sequences, a slice of heads — so plain
+    dense attention runs locally; a second all_to_all restores the
+    sequence shard.  Heads must divide the axis size.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by axis size ({axis_size})")
+    def scatter_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+    def gather_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+    out = attention(scatter_heads(q), scatter_heads(k), scatter_heads(v),
+                    causal=causal, scale=scale)
+    return gather_heads(out)

@@ -167,10 +167,16 @@ class RemoteRepo:
 
     def get_payload(self, schema: ModelSchema) -> bytes:
         uri = schema.uri
-        rel = uri if "://" not in uri else uri.rsplit("/", 1)[-1]
         try:
+            if "://" in uri:
+                # absolute URI: fetch it as stated (may live under a
+                # subdirectory or another host than base_url)
+                import urllib.request
+                with urllib.request.urlopen(
+                        uri, timeout=self.read_timeout) as r:
+                    return r.read()
             # large payloads get the (longer) read window
-            return self._fetch(rel, timeout=self.read_timeout)
+            return self._fetch(uri, timeout=self.read_timeout)
         except Exception as e:
             raise ModelNotFoundError(uri) from e
 
@@ -209,7 +215,7 @@ class ModelDownloader:
             raise ValueError(
                 f"downloaded hash {digest} does not match schema hash "
                 f"{schema.hash} for model {schema.name} (Schema.scala:35-41)")
-        tmp = target + ".tmp"
+        tmp = f"{target}.{os.getpid()}.tmp"  # per-process: concurrent syncs
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, target)

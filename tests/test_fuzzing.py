@@ -226,3 +226,15 @@ def test_api_summary_generates():
     doc = api_summary()
     assert "TrainClassifier" in doc and "| param |" in doc
     assert len(doc) > 2000
+
+
+def test_api_doc_in_sync():
+    """docs/api.md is generated; keep it current with the param docs."""
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "api.md")
+    with open(path) as f:
+        committed = f.read()
+    assert committed.strip() == api_summary().strip(), (
+        "docs/api.md is stale; regenerate with: python -c \"from "
+        "mmlspark_tpu.utils import api_summary; "
+        "open('docs/api.md','w').write(api_summary())\"")

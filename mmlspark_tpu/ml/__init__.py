@@ -8,6 +8,14 @@ from mmlspark_tpu.ml.learners import (
     NaiveBayes,
     OneVsRest,
 )
+from mmlspark_tpu.ml.trees import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    GBTClassifier,
+    GBTRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
 from mmlspark_tpu.ml.train_classifier import TrainClassifier, TrainedClassifierModel
 from mmlspark_tpu.ml.train_regressor import TrainRegressor, TrainedRegressorModel
 from mmlspark_tpu.ml.statistics import (
@@ -19,6 +27,8 @@ from mmlspark_tpu.ml.find_best_model import BestModel, FindBestModel
 __all__ = [
     "LogisticRegression", "LinearRegression", "NaiveBayes",
     "MultilayerPerceptronClassifier", "OneVsRest",
+    "DecisionTreeClassifier", "RandomForestClassifier", "GBTClassifier",
+    "DecisionTreeRegressor", "RandomForestRegressor", "GBTRegressor",
     "TrainClassifier", "TrainedClassifierModel",
     "TrainRegressor", "TrainedRegressorModel",
     "ComputeModelStatistics", "ComputePerInstanceStatistics",

@@ -1,0 +1,475 @@
+"""Tree learners as histogram-based XLA programs.
+
+The reference's TrainClassifier/Regressor dispatch to Spark MLlib's
+DecisionTree/RandomForest/GBT learners (TrainClassifier.scala:75-77) —
+JVM recursion over row partitions.  Trees are the SURVEY's flagged hard
+part for TPU: XLA wants static shapes and no data-dependent recursion.
+The design here:
+
+  * features are quantile-binned once to int bins (maxBins, default 32);
+  * every tree is a COMPLETE binary tree of static depth D — "no split"
+    is encoded as a send-everything-left split, so tree traversal is a
+    fixed D-step gather loop, and growth is a fixed D-level loop;
+  * each level builds (feature x node x bin) gradient/hessian histograms
+    with one segment_sum per feature (vmapped) — the classic LightGBM/
+    XGBoost histogram trick, batched so the MXU/VPU stays fed;
+  * split gain is the XGBoost Newton gain; leaves take -G/(H+lambda).
+
+Boosting (GBT) wraps tree-building with logistic/squared-loss gradients;
+forests (RF) bag Poisson row weights + feature subsets; a decision tree
+is a forest of one.  Binary GBT only, as the reference
+(TrainClassifier.scala:101-104 throws on multiclass GBT); multiclass
+DT/RF use per-class probability trees.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Estimator
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.ml.learners import (ClassifierModel, RegressorModel,
+                                      _features_matrix, _sigmoid)
+
+
+# --------------------------------------------------------------------------
+# binning
+# --------------------------------------------------------------------------
+
+def quantile_bin_edges(X: np.ndarray, max_bins: int) -> np.ndarray:
+    """Per-feature quantile edges, (F, max_bins-1).
+
+    Mirrors MLlib's quantile-based continuous-feature binning (its maxBins
+    param has the same meaning)."""
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    edges = np.quantile(X, qs, axis=0).T.astype(np.float32)  # (F, B-1)
+    return edges
+
+
+@jax.jit
+def bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """(n, F) float -> (n, F) int32 bin ids via per-feature searchsorted."""
+    return jax.vmap(lambda col, e: jnp.searchsorted(e, col),
+                    in_axes=(1, 0), out_axes=1)(X, edges).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# single-tree build + predict (jitted, static depth/bins)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def build_tree(binned, grad, hess, depth: int, n_bins: int,
+               lam: float = 1.0, feature_mask=None):
+    """Grow one complete tree of `depth` levels.
+
+    binned: (n, F) int32; grad/hess: (n,) float32 (zero-weight rows simply
+    contribute nothing).  Returns (split_feature (I,), split_bin (I,),
+    leaf_value (2**depth,)) with I = 2**depth - 1 internal nodes laid out
+    heap-style; "no split" is (feature 0, bin n_bins) => all rows go left.
+    """
+    n, F = binned.shape
+    n_internal = 2 ** depth - 1
+    split_feature = jnp.zeros(n_internal, jnp.int32)
+    split_bin = jnp.full(n_internal, n_bins, jnp.int32)
+    node_of_row = jnp.zeros(n, jnp.int32)       # heap index of each row
+
+    for d in range(depth):
+        level_size = 2 ** d
+        first = level_size - 1
+        local = node_of_row - first              # 0..level_size-1
+        seg = local * n_bins                     # base segment per node
+
+        def hists(col):
+            idx = seg + col
+            hg = jax.ops.segment_sum(grad, idx, level_size * n_bins)
+            hh = jax.ops.segment_sum(hess, idx, level_size * n_bins)
+            return hg.reshape(level_size, n_bins), hh.reshape(level_size, n_bins)
+
+        hg, hh = jax.vmap(hists, in_axes=1)(binned)   # (F, nodes, bins)
+        GL = jnp.cumsum(hg, axis=-1)
+        HL = jnp.cumsum(hh, axis=-1)
+        G = GL[..., -1:]
+        H = HL[..., -1:]
+        GR, HR = G - GL, H - HL
+
+        def score(g, h):
+            return g * g / (h + lam)
+
+        gain = score(GL, HL) + score(GR, HR) - score(G, H)   # (F, nodes, bins)
+        # a split at bin b sends bins <= b left; the last bin is no-split
+        gain = gain.at[..., -1].set(-jnp.inf)
+        # empty children are useless splits
+        gain = jnp.where((HL <= 0) | (HR <= 0), -jnp.inf, gain)
+        if feature_mask is not None:
+            gain = jnp.where(feature_mask[:, None, None], gain, -jnp.inf)
+
+        flat = gain.transpose(1, 0, 2).reshape(level_size, F * n_bins)
+        best = jnp.argmax(flat, axis=1)                       # (nodes,)
+        best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+        feat = (best // n_bins).astype(jnp.int32)
+        b = (best % n_bins).astype(jnp.int32)
+        do_split = best_gain > 1e-12
+        feat = jnp.where(do_split, feat, 0)
+        b = jnp.where(do_split, b, n_bins)                    # no-split: left
+
+        split_feature = jax.lax.dynamic_update_slice(split_feature, feat,
+                                                     (first,))
+        split_bin = jax.lax.dynamic_update_slice(
+            split_bin, b.astype(jnp.int32), (first,))
+
+        row_feat = feat[local]
+        row_thr = b[local]
+        go_right = binned[jnp.arange(n), row_feat] > row_thr
+        node_of_row = 2 * node_of_row + 1 + go_right.astype(jnp.int32)
+
+    leaf_local = node_of_row - n_internal
+    n_leaves = 2 ** depth
+    leaf_g = jax.ops.segment_sum(grad, leaf_local, n_leaves)
+    leaf_h = jax.ops.segment_sum(hess, leaf_local, n_leaves)
+    leaf_value = -leaf_g / (leaf_h + lam)
+    return split_feature, split_bin, leaf_value
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def predict_tree(binned, split_feature, split_bin, leaf_value, depth: int):
+    """(n, F) bins -> (n,) leaf values in `depth` gather steps."""
+    n = binned.shape[0]
+    node = jnp.zeros(n, jnp.int32)
+    for _ in range(depth):
+        feat = split_feature[node]
+        thr = split_bin[node]
+        go_right = binned[jnp.arange(n), feat] > thr
+        node = 2 * node + 1 + go_right.astype(jnp.int32)
+    return leaf_value[node - (2 ** depth - 1)]
+
+
+# --------------------------------------------------------------------------
+# ensembles
+# --------------------------------------------------------------------------
+
+class TreeEnsemble:
+    """Bins + a list of (feature, bin, leaf) arrays + a bias."""
+
+    def __init__(self, edges: np.ndarray, depth: int, bias: float = 0.0):
+        self.edges = np.asarray(edges, np.float32)
+        self.depth = depth
+        self.bias = float(bias)
+        self.trees: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+
+    def add(self, feature, bins, leaves, weight: float = 1.0):
+        self.trees.append((np.asarray(feature), np.asarray(bins),
+                           np.asarray(leaves) * weight))
+
+    def bin(self, X: np.ndarray) -> jnp.ndarray:
+        return bin_features(jnp.asarray(X, jnp.float32),
+                            jnp.asarray(self.edges))
+
+    def raw_predict(self, X: np.ndarray, binned=None) -> np.ndarray:
+        if binned is None:
+            binned = self.bin(X)
+        out = np.full(len(X), self.bias, np.float32)
+        for f, b, l in self.trees:
+            out += np.asarray(predict_tree(binned, jnp.asarray(f),
+                                           jnp.asarray(b), jnp.asarray(l),
+                                           self.depth))
+        return out
+
+    def save(self, path: str, name: str):
+        arrs = {"edges": self.edges, "bias": np.float32(self.bias),
+                "depth": np.int32(self.depth),
+                "n_trees": np.int32(len(self.trees))}
+        for i, (f, b, l) in enumerate(self.trees):
+            arrs[f"f{i}"] = f
+            arrs[f"b{i}"] = b
+            arrs[f"l{i}"] = l
+        np.savez(os.path.join(path, f"{name}.npz"), **arrs)
+
+    @staticmethod
+    def load(path: str, name: str) -> "TreeEnsemble":
+        d = np.load(os.path.join(path, f"{name}.npz"))
+        ens = TreeEnsemble(d["edges"], int(d["depth"]), float(d["bias"]))
+        for i in range(int(d["n_trees"])):
+            ens.trees.append((d[f"f{i}"], d[f"b{i}"], d[f"l{i}"]))
+        return ens
+
+
+def _fit_boosted(X, y, *, depth, n_bins, n_trees, step, lam, loss,
+                 row_weights=None, feature_masks=None, boost=True,
+                 prebinned=None):
+    """Generic tree-ensemble loop; one jitted build per round.
+
+    boost=True: gradients from the running prediction (GBT).
+    boost=False: gradients always from the bias — trees are independent
+    fits of (y - bias), so step=1/T yields forest averaging (RF/DT).
+    `prebinned=(edges, binned)` skips the quantile/binning pass (shared
+    across the per-class ensembles of a multiclass forest).
+    """
+    if prebinned is not None:
+        edges, binned = prebinned
+    else:
+        edges = quantile_bin_edges(X, n_bins)
+        binned = bin_features(jnp.asarray(X, jnp.float32), jnp.asarray(edges))
+    yj = jnp.asarray(y, jnp.float32)
+    w = (jnp.asarray(row_weights, jnp.float32)
+         if row_weights is not None else None)
+
+    if loss == "logistic":
+        bias = 0.0
+    else:
+        bias = float(np.mean(y)) if len(y) else 0.0
+    ens = TreeEnsemble(edges, depth, bias)
+    pred = jnp.full(len(y), bias, jnp.float32)
+
+    for t in range(n_trees):
+        if loss == "logistic":
+            p = jax.nn.sigmoid(pred)
+            grad, hess = p - yj, p * (1 - p)
+        else:
+            grad, hess = pred - yj, jnp.ones_like(pred)
+        if w is not None:
+            wt = w if w.ndim == 1 else w[t]
+            grad, hess = grad * wt, hess * wt
+        mask = (jnp.asarray(feature_masks[t])
+                if feature_masks is not None else None)
+        f, b, l = build_tree(binned, grad, hess, depth, n_bins, lam, mask)
+        ens.add(f, b, l, weight=step)
+        if boost:
+            pred = pred + step * predict_tree(binned, f, b, l, depth)
+    return ens
+
+
+def _subset_size(n_feats: int, strategy: str) -> int:
+    """Features per tree (Spark featureSubsetStrategy vocabulary)."""
+    if strategy in ("sqrt", "auto"):
+        k = int(np.sqrt(n_feats))
+    elif strategy == "log2":
+        k = int(np.log2(max(n_feats, 2)))
+    elif strategy == "onethird":
+        k = n_feats // 3
+    else:
+        k = int(n_feats * float(strategy))
+    return min(max(k, 1), n_feats)
+
+
+def _valid_strategy(v: str) -> bool:
+    if v in ("all", "sqrt", "auto", "log2", "onethird"):
+        return True
+    try:
+        return 0.0 < float(v) <= 1.0
+    except ValueError:
+        return False
+
+
+def _bagging(n_rows, n_feats, n_trees, subsample, feat_strategy, rng):
+    """Poisson row weights + per-tree feature masks (static shapes)."""
+    weights = rng.poisson(subsample, size=(n_trees, n_rows)).astype(np.float32)
+    if feat_strategy == "all" or n_feats <= 1:
+        masks = np.ones((n_trees, n_feats), bool)
+    else:
+        k = _subset_size(n_feats, feat_strategy)
+        masks = np.zeros((n_trees, n_feats), bool)
+        for t in range(n_trees):
+            masks[t, rng.choice(n_feats, size=k, replace=False)] = True
+    return weights, masks
+
+
+# --------------------------------------------------------------------------
+# models
+# --------------------------------------------------------------------------
+
+class TreeClassifierModel(ClassifierModel):
+    """Per-class probability ensembles (DT/RF) or a logit ensemble (GBT)."""
+
+    def __init__(self, ensembles: Optional[list] = None,
+                 mode: str = "prob", **kw):
+        super().__init__(**kw)
+        self._ensembles = list(ensembles or [])
+        self._mode = mode  # "prob" (leaf-mean trees) | "logit" (boosted)
+
+    @property
+    def num_classes(self) -> int:
+        return max(len(self._ensembles), 2)
+
+    def _score(self, X):
+        if self._mode == "logit":
+            z = self._ensembles[0].raw_predict(X)
+            p = np.asarray(_sigmoid(jnp.asarray(z)))
+            prob = np.stack([1 - p, p], 1)
+            raw = np.stack([-z, z], 1)
+            return raw, prob, (p > 0.5).astype(np.float64)
+        # all per-class ensembles share edges: bin once
+        binned = self._ensembles[0].bin(X)
+        raw = np.stack([e.raw_predict(X, binned=binned)
+                        for e in self._ensembles], 1)
+        clipped = np.clip(raw, 1e-6, 1.0)
+        prob = clipped / clipped.sum(1, keepdims=True)
+        return raw, prob, np.argmax(raw, 1).astype(np.float64)
+
+    def _save_extra(self, path):
+        with open(os.path.join(path, "mode.txt"), "w") as f:
+            f.write(f"{self._mode}\n{len(self._ensembles)}")
+        for i, e in enumerate(self._ensembles):
+            e.save(path, f"ens{i}")
+
+    def _load_extra(self, path):
+        with open(os.path.join(path, "mode.txt")) as f:
+            self._mode, n = f.read().split("\n")
+        self._ensembles = [TreeEnsemble.load(path, f"ens{i}")
+                           for i in range(int(n))]
+
+
+class TreeRegressorModel(RegressorModel):
+    def __init__(self, ensemble: Optional[TreeEnsemble] = None, **kw):
+        super().__init__(**kw)
+        self._ensemble = ensemble
+
+    def _predict(self, X):
+        return self._ensemble.raw_predict(X)
+
+    def _save_extra(self, path):
+        self._ensemble.save(path, "ens")
+
+    def _load_extra(self, path):
+        self._ensemble = TreeEnsemble.load(path, "ens")
+
+
+# --------------------------------------------------------------------------
+# estimators
+# --------------------------------------------------------------------------
+
+class _TreeParams(Estimator):
+    featuresCol = Param("features", "features column", ptype=str)
+    labelCol = Param("label", "label column", ptype=str)
+    maxDepth = Param(5, "tree depth", ptype=int, validator=lambda v: 1 <= v <= 12)
+    maxBins = Param(32, "histogram bins per feature", ptype=int,
+                    validator=lambda v: 2 <= v <= 256)
+    lam = Param(1.0, "L2 leaf regularization", ptype=float)
+    seed = Param(0, "sampling seed", ptype=int)
+
+    def _xy(self, table: DataTable):
+        X = _features_matrix(table[self.featuresCol]).astype(np.float32)
+        y = np.asarray(table[self.labelCol], np.float64)
+        return X, y
+
+
+def _per_class_forest(X, y, n_classes, *, depth, n_bins, n_trees, lam,
+                      subsample, feat_strategy, seed):
+    """Probability forests: per class, trees of leaf-mean(indicator)."""
+    rng = np.random.default_rng(seed)
+    weights, masks = _bagging(len(X), X.shape[1], n_trees, subsample,
+                              feat_strategy, rng)
+    # one quantile/binning pass shared by all K class ensembles
+    edges = quantile_bin_edges(X, n_bins)
+    binned = bin_features(jnp.asarray(X, jnp.float32), jnp.asarray(edges))
+    ensembles = []
+    for c in range(n_classes):
+        target = (y == c).astype(np.float32)
+        # squared loss from a zero bias: leaf value = smoothed mean of the
+        # indicator = P(class | leaf); average over trees with weight 1/T
+        ens = _fit_boosted(X, target, depth=depth, n_bins=n_bins,
+                           n_trees=n_trees, step=1.0 / n_trees, lam=lam,
+                           loss="squared",
+                           row_weights=weights if n_trees > 1 else None,
+                           feature_masks=masks, boost=False,
+                           prebinned=(edges, binned))
+        ensembles.append(ens)
+    return ensembles
+
+
+class DecisionTreeClassifier(_TreeParams):
+    """Single probability tree (Spark DecisionTreeClassifier counterpart)."""
+
+    def fit(self, table: DataTable) -> TreeClassifierModel:
+        X, y = self._xy(table)
+        n_classes = int(y.max()) + 1 if len(y) else 2
+        ens = _per_class_forest(X, y, max(n_classes, 2), depth=self.maxDepth,
+                                n_bins=self.maxBins, n_trees=1, lam=self.lam,
+                                subsample=1.0, feat_strategy="all",
+                                seed=self.seed)
+        return TreeClassifierModel(ens, featuresCol=self.featuresCol)
+
+
+class RandomForestClassifier(_TreeParams):
+    numTrees = Param(20, "trees in the forest", ptype=int)
+    subsamplingRate = Param(1.0, "Poisson bootstrap rate", ptype=float)
+    featureSubsetStrategy = Param(
+        "sqrt", "all | auto | sqrt | log2 | onethird | fraction in (0,1]",
+        ptype=str, validator=_valid_strategy)
+
+    def fit(self, table: DataTable) -> TreeClassifierModel:
+        X, y = self._xy(table)
+        n_classes = int(y.max()) + 1 if len(y) else 2
+        ens = _per_class_forest(
+            X, y, max(n_classes, 2), depth=self.maxDepth, n_bins=self.maxBins,
+            n_trees=self.numTrees, lam=self.lam,
+            subsample=self.subsamplingRate,
+            feat_strategy=self.featureSubsetStrategy, seed=self.seed)
+        return TreeClassifierModel(ens, featuresCol=self.featuresCol)
+
+
+class GBTClassifier(_TreeParams):
+    """Binary logistic boosting; multiclass unsupported, as the reference
+    (TrainClassifier.scala:101-104)."""
+
+    maxIter = Param(20, "boosting rounds", ptype=int)
+    stepSize = Param(0.1, "shrinkage", ptype=float)
+
+    def fit(self, table: DataTable) -> TreeClassifierModel:
+        X, y = self._xy(table)
+        if len(y) and y.max() > 1:
+            raise ValueError("Multiclass GBTClassifier is not supported "
+                             "(reference TrainClassifier.scala:101-104)")
+        ens = _fit_boosted(X, y.astype(np.float32), depth=self.maxDepth,
+                           n_bins=self.maxBins, n_trees=self.maxIter,
+                           step=self.stepSize, lam=self.lam, loss="logistic")
+        return TreeClassifierModel([ens], mode="logit",
+                                   featuresCol=self.featuresCol)
+
+
+class DecisionTreeRegressor(_TreeParams):
+    def fit(self, table: DataTable) -> TreeRegressorModel:
+        X, y = self._xy(table)
+        ens = _fit_boosted(X, y.astype(np.float32), depth=self.maxDepth,
+                           n_bins=self.maxBins, n_trees=1, step=1.0,
+                           lam=self.lam, loss="squared")
+        return TreeRegressorModel(ens, featuresCol=self.featuresCol)
+
+
+class RandomForestRegressor(_TreeParams):
+    numTrees = Param(20, "trees in the forest", ptype=int)
+    subsamplingRate = Param(1.0, "Poisson bootstrap rate", ptype=float)
+    featureSubsetStrategy = Param(
+        "sqrt", "all | auto | sqrt | log2 | onethird | fraction in (0,1]",
+        ptype=str, validator=_valid_strategy)
+
+    def fit(self, table: DataTable) -> TreeRegressorModel:
+        X, y = self._xy(table)
+        rng = np.random.default_rng(self.seed)
+        weights, masks = _bagging(len(X), X.shape[1], self.numTrees,
+                                  self.subsamplingRate,
+                                  self.featureSubsetStrategy, rng)
+        ens = _fit_boosted(X, y.astype(np.float32), depth=self.maxDepth,
+                           n_bins=self.maxBins, n_trees=self.numTrees,
+                           step=1.0 / self.numTrees, lam=self.lam,
+                           loss="squared", row_weights=weights,
+                           feature_masks=masks, boost=False)
+        return TreeRegressorModel(ens, featuresCol=self.featuresCol)
+
+
+class GBTRegressor(_TreeParams):
+    maxIter = Param(20, "boosting rounds", ptype=int)
+    stepSize = Param(0.1, "shrinkage", ptype=float)
+
+    def fit(self, table: DataTable) -> TreeRegressorModel:
+        X, y = self._xy(table)
+        ens = _fit_boosted(X, y.astype(np.float32), depth=self.maxDepth,
+                           n_bins=self.maxBins, n_trees=self.maxIter,
+                           step=self.stepSize, lam=self.lam, loss="squared")
+        return TreeRegressorModel(ens, featuresCol=self.featuresCol)

@@ -49,6 +49,9 @@ def all_stage_classes(concrete_only: bool = True) -> list[type]:
             # base plumbing classes are not user stages
             if cls.__module__ == "mmlspark_tpu.core.pipeline":
                 continue
+            # underscore-prefixed classes are private bases
+            if cls.__qualname__.split(".")[-1].startswith("_"):
+                continue
         out.append(cls)
     return sorted(out, key=lambda c: f"{c.__module__}.{c.__qualname__}")
 

@@ -58,10 +58,15 @@ def _fixtures():
                                       IDF, NGram, StopWordsRemover,
                                       TextFeaturizer, Tokenizer)
     from mmlspark_tpu.ml import (ComputeModelStatistics,
-                                 ComputePerInstanceStatistics, FindBestModel,
+                                 ComputePerInstanceStatistics,
+                                 DecisionTreeClassifier,
+                                 DecisionTreeRegressor, FindBestModel,
+                                 GBTClassifier, GBTRegressor,
                                  LinearRegression, LogisticRegression,
                                  MultilayerPerceptronClassifier, NaiveBayes,
-                                 OneVsRest, TrainClassifier, TrainRegressor)
+                                 OneVsRest, RandomForestClassifier,
+                                 RandomForestRegressor, TrainClassifier,
+                                 TrainRegressor)
     from mmlspark_tpu.models.tpu_model import TPUModel
     from mmlspark_tpu.train import TrainerConfig
     from mmlspark_tpu.train.learner import TPULearner
@@ -111,6 +116,18 @@ def _fixtures():
             Featurize(featureColumns={"f": ["double_0"]},
                       numberOfFeatures=64), gen),
         "LogisticRegression": lambda: (LogisticRegression(), ml),
+        "DecisionTreeClassifier": lambda: (
+            DecisionTreeClassifier(maxDepth=2), ml),
+        "RandomForestClassifier": lambda: (
+            RandomForestClassifier(maxDepth=2, numTrees=2), ml),
+        "GBTClassifier": lambda: (
+            GBTClassifier(maxDepth=2, maxIter=2), ml),
+        "DecisionTreeRegressor": lambda: (
+            DecisionTreeRegressor(maxDepth=2), ml),
+        "RandomForestRegressor": lambda: (
+            RandomForestRegressor(maxDepth=2, numTrees=2), ml),
+        "GBTRegressor": lambda: (
+            GBTRegressor(maxDepth=2, maxIter=2), ml),
         "LinearRegression": lambda: (LinearRegression(), ml),
         "NaiveBayes": lambda: (
             NaiveBayes(),
@@ -154,6 +171,7 @@ _MODEL_ONLY = {
     "NaiveBayesModel", "MultilayerPerceptronClassifierModel",
     "OneVsRestModel", "TrainedClassifierModel", "TrainedRegressorModel",
     "BestModel", "ClassifierModel", "RegressorModel", "Evaluator",
+    "TreeClassifierModel", "TreeRegressorModel",
 }
 
 

@@ -1,0 +1,164 @@
+"""Tree learner tests (reference DT/RF/GBT dispatch,
+TrainClassifier.scala:75-77, VerifyTrainClassifier tree cases)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataTable
+from mmlspark_tpu.core.pipeline import load_stage
+from mmlspark_tpu.core.schema import SchemaConstants
+from mmlspark_tpu.ml import (
+    ComputeModelStatistics,
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    GBTClassifier,
+    GBTRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    TrainClassifier,
+    TrainRegressor,
+)
+from mmlspark_tpu.ml.trees import (bin_features, build_tree, predict_tree,
+                                   quantile_bin_edges)
+
+
+def _xor_table(n=400, seed=0, noise=0.1):
+    """XOR — linearly inseparable, trivially tree-separable."""
+    rng = np.random.default_rng(seed)
+    a = rng.random(n) > 0.5
+    b = rng.random(n) > 0.5
+    X = np.stack([a + rng.normal(0, noise, n),
+                  b + rng.normal(0, noise, n)], 1).astype(np.float32)
+    y = (a ^ b).astype(np.int64)
+    return DataTable({"features": X, "label": y})
+
+
+def _step_regression(n=300, seed=1):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-2, 2, n).astype(np.float32)
+    y = np.where(x < -1, -3.0, np.where(x < 0.5, 1.0, 4.0)).astype(np.float32)
+    return DataTable({"features": x[:, None], "label": y})
+
+
+# ----------------------------------------------------------- primitives ---
+
+def test_binning_round_trip():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(100, 3)).astype(np.float32)
+    edges = quantile_bin_edges(X, 8)
+    assert edges.shape == (3, 7)
+    binned = np.asarray(bin_features(X, edges))
+    assert binned.min() >= 0 and binned.max() <= 7
+    # monotone: larger value -> same or larger bin
+    order = np.argsort(X[:, 0])
+    assert (np.diff(binned[order, 0]) >= 0).all()
+
+
+def test_single_tree_splits_a_step():
+    x = np.linspace(0, 1, 64, dtype=np.float32)[:, None]
+    y = (x[:, 0] > 0.5).astype(np.float32)
+    edges = quantile_bin_edges(x, 16)
+    binned = bin_features(x, edges)
+    import jax.numpy as jnp
+    # squared loss from zero: grad = -y
+    f, b, l = build_tree(binned, jnp.asarray(-y), jnp.ones(64), 2, 16, 0.01)
+    pred = np.asarray(predict_tree(binned, f, b, l, 2))
+    assert np.allclose(pred[x[:, 0] < 0.49], 0.0, atol=0.05)
+    assert np.allclose(pred[x[:, 0] > 0.51], 1.0, atol=0.05)
+
+
+# ------------------------------------------------------------ learners ---
+
+def test_decision_tree_solves_xor():
+    t = _xor_table()
+    model = DecisionTreeClassifier(maxDepth=4).fit(t)
+    out = model.transform(t)
+    assert np.mean(out["prediction"] == t["label"]) > 0.95
+    assert np.allclose(out["probability"].sum(1), 1.0, atol=1e-5)
+
+
+def test_random_forest_xor_and_save(tmp_path):
+    t = _xor_table(seed=2)
+    # XOR needs both features in every tree; sqrt(2)=1 feature per tree
+    # cannot express it (true of any RF implementation)
+    model = RandomForestClassifier(numTrees=10, maxDepth=4, seed=3,
+                                   featureSubsetStrategy="all").fit(t)
+    out = model.transform(t)
+    acc = np.mean(out["prediction"] == t["label"])
+    assert acc > 0.95
+    model.save(str(tmp_path / "rf"))
+    loaded = load_stage(str(tmp_path / "rf"))
+    out2 = loaded.transform(t)
+    assert (out2["prediction"] == out["prediction"]).all()
+
+
+def test_gbt_classifier_binary():
+    t = _xor_table(seed=4)
+    model = GBTClassifier(maxIter=20, maxDepth=3).fit(t)
+    out = model.transform(t)
+    assert np.mean(out["prediction"] == t["label"]) > 0.95
+
+
+def test_gbt_multiclass_rejected():
+    t = DataTable({"features": np.random.default_rng(0).normal(
+        size=(30, 2)).astype(np.float32),
+        "label": np.arange(30) % 3})
+    with pytest.raises(ValueError, match="Multiclass"):
+        GBTClassifier().fit(t)
+
+
+def test_multiclass_forest():
+    rng = np.random.default_rng(5)
+    n, k = 450, 3
+    centers = rng.normal(0, 5, size=(k, 4))
+    y = rng.integers(0, k, n)
+    X = (centers[y] + rng.normal(0, 0.5, (n, 4))).astype(np.float32)
+    t = DataTable({"features": X, "label": y.astype(np.int64)})
+    model = RandomForestClassifier(numTrees=8, maxDepth=4).fit(t)
+    out = model.transform(t)
+    assert np.mean(out["prediction"] == y) > 0.93
+
+
+def test_tree_regressors_fit_step_function():
+    t = _step_regression()
+    for est in (DecisionTreeRegressor(maxDepth=3),
+                RandomForestRegressor(numTrees=8, maxDepth=3,
+                                      featureSubsetStrategy="all"),
+                GBTRegressor(maxIter=25, maxDepth=3, stepSize=0.3)):
+        model = est.fit(t)
+        pred = model.transform(t)["prediction"]
+        rmse = float(np.sqrt(np.mean((pred - t["label"]) ** 2)))
+        assert rmse < 0.6, (type(est).__name__, rmse)
+
+
+# ------------------------------------------------- TrainClassifier wiring ---
+
+def test_train_classifier_with_trees_categorical_passthrough():
+    """Tree learners: no OHE, 4096-slot hashing (TrainClassifier.scala:75-86)."""
+    rng = np.random.default_rng(6)
+    n = 300
+    signal = rng.integers(0, 2, n)
+    t = DataTable({
+        "color": [["red", "blue"][s] for s in signal],
+        "noise": rng.normal(size=n),
+        "mylabel": signal.astype(np.int64),
+    })
+    from mmlspark_tpu.core.schema import make_categorical
+    t = make_categorical(t, "color")
+    model = TrainClassifier(RandomForestClassifier(numTrees=5, maxDepth=3),
+                            labelCol="mylabel").fit(t)
+    scored = model.transform(t)
+    stats = ComputeModelStatistics().transform(scored)
+    assert float(stats["accuracy"][0]) > 0.95
+    # categoricals passed as indices, not one-hot: 1 cat + 1 numeric = 2 dims
+    blocks = scored.meta("features").extra["feature_blocks"]
+    assert blocks[0]["kind"] == "categorical" and blocks[0]["width"] == 1
+
+
+def test_train_regressor_with_gbt():
+    t = _step_regression()
+    t2 = DataTable({"x": t["features"][:, 0], "target": t["label"]})
+    model = TrainRegressor(GBTRegressor(maxIter=20, maxDepth=3, stepSize=0.3),
+                           labelCol="target").fit(t2)
+    stats = ComputeModelStatistics().transform(model.transform(t2))
+    assert float(stats["R^2"][0]) > 0.9

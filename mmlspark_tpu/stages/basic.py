@@ -95,8 +95,12 @@ class CheckpointData(Transformer):
 
     def transform(self, table: DataTable) -> DataTable:
         import jax
-        out = table.select(*table.columns)  # derived table; input untouched
+        out = table.select(*table.columns)
         if self.removeCheckpoint:
+            # deliberate mutation of the input (the one exception to the
+            # derived-table convention): any holder of the input table keeps
+            # HBM pinned through its _device_cache, so drop it there too
+            table.__dict__.pop("_device_cache", None)
             return out
         cache: dict[str, object] = {}
         for name in out.columns:

@@ -154,7 +154,11 @@ class Trainer:
             lambda leaf: jax.device_put(leaf, replicated(self.mesh)), batch_stats)
         # opt_state leaves mirror params; jit propagates their shardings
         opt_state = jax.jit(self._tx.init)(params)
-        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+        # warm starts resume the global step (bundle_from_state stamps it)
+        # so checkpoint_every_steps boundaries align across fit() calls
+        start = int((initial_bundle.metadata or {}).get("steps", 0)) \
+            if initial_bundle is not None else 0
+        return TrainState(step=jnp.asarray(start, jnp.int32), params=params,
                           opt_state=opt_state, batch_stats=batch_stats)
 
     # -- the compiled step ----------------------------------------------
@@ -206,7 +210,10 @@ class Trainer:
 
         rng = np.random.default_rng(cfg.seed)
         t0 = time.perf_counter()
-        step = 0  # host-side counter; never sync on state.step mid-epoch
+        # host-side counter seeded once from the (possibly resumed) global
+        # step so checkpoint_every_steps boundaries stay aligned across
+        # fit() calls; never sync on state.step mid-epoch
+        step = int(state.step)
         for epoch in range(cfg.epochs):
             order = rng.permutation(n) if cfg.shuffle_each_epoch else np.arange(n)
             losses: list = []

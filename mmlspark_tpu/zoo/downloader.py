@@ -49,7 +49,7 @@ class ModelSchema:
 
     @property
     def filename(self) -> str:
-        return f"{self.name}_{self.dataset}.tpubundle"
+        return _bundle_filename(self.name, self.dataset)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -57,6 +57,21 @@ class ModelSchema:
     @staticmethod
     def from_json(d: dict) -> "ModelSchema":
         return ModelSchema(**d)
+
+
+def _safe_component(part: str) -> str:
+    """Reject path-traversal in remote-supplied schema fields: a hostile
+    manifest must not be able to steer the cache target outside the cache
+    dir (the manifest's sha256 is attacker-chosen, so it offers no
+    protection)."""
+    if (not part or part in (".", "..") or "/" in part or "\\" in part
+            or os.path.basename(part) != part):
+        raise ValueError(f"unsafe model schema path component: {part!r}")
+    return part
+
+
+def _bundle_filename(name: str, dataset: str) -> str:
+    return f"{_safe_component(name)}_{_safe_component(dataset)}.tpubundle"
 
 
 def sha256_file(path: str) -> str:
@@ -122,10 +137,10 @@ class LocalRepo:
     def add_model(self, bundle: ModelBundle, name: str, dataset: str,
                   model_type: str = "image") -> ModelSchema:
         """Publish a bundle into this repo (addBytes analogue)."""
+        payload = os.path.join(self.path, _bundle_filename(name, dataset))
         with tempfile.TemporaryDirectory() as tmp:
             bdir = os.path.join(tmp, "bundle")
             save_bundle(bundle, bdir)
-            payload = os.path.join(self.path, f"{name}_{dataset}.tpubundle")
             pack_bundle(bdir, payload)
         meta = bundle.metadata or {}
         schema = ModelSchema(

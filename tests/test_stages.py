@@ -65,6 +65,9 @@ def test_checkpoint_device_cache(table):
     assert set(cache) == {"a", "b"}
     released = CheckpointData(removeCheckpoint=True).transform(out)
     assert CheckpointData.get_device_cache(released) == {}
+    # release drops the buffers on the *input* table too, so HBM is
+    # actually freed even while references to it remain
+    assert CheckpointData.get_device_cache(out) == {}
 
 
 # ------------------------------------------------------- data conversion ---

@@ -83,6 +83,18 @@ def test_fine_tune_warm_start():
     np.testing.assert_allclose(np.asarray(w0), np.asarray(w1), atol=1e-7)
 
 
+def test_warm_start_resumes_global_step():
+    """Continued training resumes the global step recorded in the bundle,
+    keeping checkpoint_every_steps boundaries aligned across fit() calls."""
+    x, y = two_blob_data(n=128)
+    t = DataTable({"features": x, "label": y})
+    cfg = mlp_config(epochs=2, batch_size=64)  # 2 steps/epoch -> 4 steps
+    first = TPULearner(cfg).fit(t)
+    assert first.bundle.metadata["steps"] == 4
+    cont = TPULearner(cfg).set_initial_bundle(first.bundle).fit(t)
+    assert cont.bundle.metadata["steps"] == 8
+
+
 def test_tensor_parallel_mesh_trains():
     x, y = two_blob_data(n=128)
     cfg = mlp_config(epochs=3,

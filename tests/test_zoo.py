@@ -60,6 +60,18 @@ def test_download_roundtrip_bundle(tmp_path, source_repo):
     assert out.shape == (2, 3)
 
 
+def test_hostile_schema_name_rejected(tmp_path, source_repo):
+    """A malicious manifest must not steer the cache write outside the
+    cache dir (its sha256 is attacker-chosen, so it offers no protection)."""
+    dl = ModelDownloader(str(tmp_path / "cache"))
+    src = list(source_repo.list_schemas())[0]
+    for bad in ("../evil", "a/b", "..", "x\\y", ""):
+        import dataclasses
+        hostile = dataclasses.replace(src, name=bad)
+        with pytest.raises(ValueError, match="unsafe"):
+            dl.download_model(source_repo, hostile)
+
+
 def test_download_unknown_model(tmp_path, source_repo):
     dl = ModelDownloader(str(tmp_path / "cache"))
     with pytest.raises(ModelNotFoundError):

@@ -62,6 +62,45 @@ def shard_table_columns(table, columns: Sequence[str], mesh: Mesh,
     return out, valid
 
 
+def put_sharded(local: np.ndarray, sharding: NamedSharding) -> jax.Array:
+    """Assemble a global device array from this process's local rows.
+
+    Single-process: a plain `device_put`.  Multi-host (the replacement for
+    the reference's per-node MPI data feed, CommandBuilders.scala:95-117):
+    every process contributes only the rows its addressable devices hold, and
+    `jax.make_array_from_process_local_data` stitches them into one global
+    array — no host ever materializes the global batch.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+_gather_fns: dict[Mesh, Any] = {}
+
+
+def gather_replicated(tree: Any, mesh: Mesh) -> Any:
+    """All-gather a pytree to fully-replicated device arrays.
+
+    Under multi-host, shards owned by other processes are not addressable;
+    an XLA identity jit with fully-replicated output shardings performs the
+    all-gather over ICI/DCN.  Every process must call this (it is a
+    collective).  The jitted gather is cached per mesh so repeated
+    checkpoints don't re-lower/re-compile.
+    """
+    if mesh not in _gather_fns:
+        _gather_fns[mesh] = jax.jit(lambda t: t,
+                                    out_shardings=replicated(mesh))
+    return _gather_fns[mesh](tree)
+
+
+def gather_to_host(tree: Any, mesh: Mesh) -> Any:
+    """Fetch a pytree of (possibly cross-process sharded) arrays to host."""
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    return jax.device_get(gather_replicated(tree, mesh))
+
+
 def replicate_tree(tree: Any, mesh: Mesh) -> Any:
     """Replicate a pytree (model weights) across the mesh."""
     sharding = replicated(mesh)

@@ -70,11 +70,19 @@ def make_mesh(spec: Optional[MeshSpec] = None,
 
 
 def best_mesh(n_data: Optional[int] = None) -> Mesh:
-    """The default 1-D data-parallel mesh (the CNTKModel scoring topology)."""
+    """The default 1-D data-parallel mesh (the CNTKModel scoring topology).
+
+    Under multi-host the default spans only this process's devices: scoring
+    is embarrassingly parallel over row partitions (the reference's
+    per-partition eval loop, CNTKModel.scala:215-221), so each host scores
+    its local rows with no cross-host collectives or lockstep batching.
+    Training meshes (which DO span hosts) are built explicitly via
+    `make_mesh`.
+    """
+    local = jax.local_devices() if jax.process_count() > 1 else jax.devices()
     if n_data is None:
-        return make_mesh(MeshSpec())
-    devices = jax.devices()[:n_data]
-    return make_mesh(MeshSpec(data=n_data), devices)
+        return make_mesh(MeshSpec(), local)
+    return make_mesh(MeshSpec(data=n_data), local[:n_data])
 
 
 def batch_sharding(mesh: Mesh, *, axis: str = DATA_AXIS) -> NamedSharding:

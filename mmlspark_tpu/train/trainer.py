@@ -37,6 +37,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mmlspark_tpu.models.bundle import ModelBundle, _to_plain
 from mmlspark_tpu.models.definitions import build_model
+from mmlspark_tpu.parallel.bridge import (gather_replicated, gather_to_host,
+                                          put_sharded)
+from mmlspark_tpu.parallel.distributed import initialize_distributed, is_coordinator
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh, replicated
 from mmlspark_tpu.train.config import TrainerConfig
 
@@ -96,6 +99,10 @@ class Trainer:
     def __init__(self, config: TrainerConfig, mesh=None):
         self.config = config
         self.module = build_model(config.architecture, config.model_config)
+        # wire up jax.distributed from env when launched multi-host (no-op
+        # in the common single-process case); must precede mesh construction
+        # so the mesh spans all hosts' devices
+        initialize_distributed()
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
         sig = inspect.signature(type(self.module).__call__)
         self._has_train_arg = "train" in sig.parameters
@@ -196,19 +203,53 @@ class Trainer:
                    initial_bundle: Optional[ModelBundle] = None,
                    log_every: int = 50,
                    log_fn: Optional[Callable[[str], None]] = None) -> ModelBundle:
+        """Train on arrays; under multi-host, `x`/`y` are this process's
+        local data partition (the per-node data shard of the reference's
+        MPI topology, CommandBuilders.scala:95-117) and each process
+        contributes `batch_size / process_count` rows per global step via
+        `put_sharded` — no host ever holds the global batch.
+        """
         cfg = self.config
+        nproc = jax.process_count()
         n = len(x)
-        bs = cfg.batch_size
         data_size = self.mesh.shape[DATA_AXIS]
+        if nproc > 1:
+            if data_size % nproc:
+                raise ValueError(
+                    f"multi-host training needs the data axis "
+                    f"({data_size}) to be a multiple of the process count "
+                    f"({nproc}); keep tensor/sequence parallelism within a "
+                    "host (over ICI) and scale data parallelism across "
+                    "hosts (over DCN)")
+            # all processes must agree on the step count or the collectives
+            # deadlock; train on the smallest partition's row count
+            from jax.experimental import multihost_utils
+            n = int(multihost_utils.process_allgather(
+                np.asarray(len(x))).min())
+            # save_checkpoint is a collective: every process must take the
+            # checkpoint branches in lockstep or the job deadlocks
+            flags = np.asarray([int(bool(cfg.checkpoint_dir)),
+                                int(cfg.checkpoint_every_steps or 0)])
+            all_flags = multihost_utils.process_allgather(flags)
+            if not (all_flags == flags).all():
+                raise ValueError(
+                    "checkpoint_dir/checkpoint_every_steps must be set "
+                    "consistently on every process (checkpointing is a "
+                    f"collective); got {all_flags.tolist()}")
+        bs = cfg.batch_size
         bs = max(bs - bs % data_size, data_size)
-        steps_per_epoch = max(1, (n + bs - 1) // bs)
+        # rows this process feeds per global step; data_size % nproc == 0
+        # and bs % data_size == 0 guarantee equal whole-row shares >= 1
+        bs_local = bs // nproc
+        steps_per_epoch = max(1, (n + bs_local - 1) // bs_local)
         total_steps = steps_per_epoch * cfg.epochs
 
         state = self.init_state((1,) + x.shape[1:], total_steps, initial_bundle)
         step_fn = self.make_train_step()
         x_sh = batch_sharding(self.mesh)
 
-        rng = np.random.default_rng(cfg.seed)
+        # distinct per-process streams so partitions shuffle independently
+        rng = np.random.default_rng(cfg.seed + jax.process_index())
         t0 = time.perf_counter()
         # host-side counter seeded once from the (possibly resumed) global
         # step so checkpoint_every_steps boundaries stay aligned across
@@ -217,17 +258,17 @@ class Trainer:
         for epoch in range(cfg.epochs):
             order = rng.permutation(n) if cfg.shuffle_each_epoch else np.arange(n)
             losses: list = []
-            for start in range(0, n, bs):
-                idx = order[start:start + bs]
+            for start in range(0, n, bs_local):
+                idx = order[start:start + bs_local]
                 valid = len(idx)
-                if valid < bs:
+                if valid < bs_local:
                     # cycle real rows into the pad (see module docstring)
-                    idx = np.concatenate([idx, np.resize(order, bs - valid)])
-                mask = np.zeros(bs, np.float32)
+                    idx = np.concatenate([idx, np.resize(order, bs_local - valid)])
+                mask = np.zeros(bs_local, np.float32)
                 mask[:valid] = 1.0
-                xb = jax.device_put(x[idx], x_sh)
-                yb = jax.device_put(y[idx], x_sh)
-                mask_d = jax.device_put(mask, x_sh)
+                xb = put_sharded(x[idx], x_sh)
+                yb = put_sharded(y[idx], x_sh)
+                mask_d = put_sharded(mask, x_sh)
                 state, loss = step_fn(state, xb, yb, mask_d)
                 losses.append(loss)  # device array; fetched at epoch end
                 step += 1
@@ -248,19 +289,29 @@ class Trainer:
         return self.bundle_from_state(state)
 
     def bundle_from_state(self, state: TrainState) -> ModelBundle:
-        variables = {"params": jax.device_get(state.params)}
+        # collective under multi-host (gathers TP-sharded leaves); every
+        # process gets the full bundle
+        variables = {"params": gather_to_host(state.params, self.mesh)}
         if state.batch_stats:
-            variables["batch_stats"] = jax.device_get(state.batch_stats)
+            variables["batch_stats"] = gather_to_host(state.batch_stats,
+                                                      self.mesh)
         return ModelBundle.from_module(self.module, variables,
                                        metadata={"steps": int(state.step)})
 
     # -- checkpoint / resume (absent in the reference; first-class here) --
     def save_checkpoint(self, state: TrainState, ckpt_dir: str) -> str:
-        os.makedirs(ckpt_dir, exist_ok=True)
-        host = jax.device_get(
+        """Write an atomic checkpoint; a collective under multi-host (the
+        gather runs on every process) but only the coordinator writes, so
+        concurrent hosts sharing a filesystem never race."""
+        dev = gather_replicated(
             {"step": state.step, "params": state.params,
-             "opt_state": state.opt_state, "batch_stats": state.batch_stats})
+             "opt_state": state.opt_state, "batch_stats": state.batch_stats},
+            self.mesh)
         path = os.path.join(ckpt_dir, "checkpoint.msgpack")
+        if not is_coordinator():
+            return path  # the gather ran (collective); skip the D2H copy
+        host = jax.device_get(dev)
+        os.makedirs(ckpt_dir, exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(serialization.to_bytes(host))
@@ -268,12 +319,36 @@ class Trainer:
         return path
 
     def restore_checkpoint(self, state: TrainState, ckpt_dir: str) -> TrainState:
+        """Restore from the coordinator's checkpoint.  Under multi-host only
+        the coordinator reads the file (matching coordinator-only writes —
+        no shared filesystem required); values reach the other hosts via a
+        broadcast collective."""
         path = os.path.join(ckpt_dir, "checkpoint.msgpack")
-        host = jax.device_get(
+        # from_bytes needs only shapes/dtypes/structure — build the template
+        # locally (no collectives, no D2H of live state)
+        template = jax.tree_util.tree_map(
+            lambda a: np.zeros(np.shape(a), a.dtype),
             {"step": state.step, "params": state.params,
              "opt_state": state.opt_state, "batch_stats": state.batch_stats})
-        with open(path, "rb") as f:
-            restored = serialization.from_bytes(host, f.read())
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            # agree on readability first: if the coordinator raised while
+            # the others sat in the broadcast collective, the job would
+            # hang with no pointer to the cause
+            readable = int(multihost_utils.broadcast_one_to_all(
+                np.asarray(int(os.path.exists(path)), np.int32)))
+            if not readable:
+                raise FileNotFoundError(
+                    f"coordinator has no checkpoint at {path}")
+            if is_coordinator():
+                with open(path, "rb") as f:
+                    host = serialization.from_bytes(template, f.read())
+            else:
+                host = template
+            restored = multihost_utils.broadcast_one_to_all(host)
+        else:
+            with open(path, "rb") as f:
+                restored = serialization.from_bytes(template, f.read())
         put = lambda new, old: jax.device_put(new, old.sharding) \
             if hasattr(old, "sharding") else new
         return TrainState(

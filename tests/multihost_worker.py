@@ -1,0 +1,84 @@
+"""Worker process for the multi-host training test.
+
+Each worker is one "host" of a 2-process jax.distributed cluster over
+localhost (CPU backend, 4 virtual devices per process = 8 global devices,
+matching the single-process test mesh).  The worker never calls
+`initialize_distributed` itself: `Trainer.__init__` picks the rendezvous up
+from the `MMLSPARK_TPU_*` env vars, which is exactly the production wiring
+(parallel/distributed.py replaces the reference's mpiexec hostfile topology,
+CommandBuilders.scala:95-117).
+
+Invoked as: python multihost_worker.py <out_dir>
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+def make_data(n=128, seed=0):
+    """Deterministic two-blob data, identical in driver and workers."""
+    rng = np.random.default_rng(seed)
+    half = n // 2
+    x0 = rng.normal(loc=-2.0, size=(half, 4)).astype(np.float32)
+    x1 = rng.normal(loc=+2.0, size=(n - half, 4)).astype(np.float32)
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(half, np.int32), np.ones(n - half, np.int32)])
+    return x, y
+
+
+def trainer_config(ckpt_dir=None):
+    from mmlspark_tpu.train import TrainerConfig
+    return TrainerConfig(
+        architecture="MLPClassifier",
+        model_config={"hidden_sizes": [16], "num_classes": 2,
+                      "dtype": "float32"},
+        optimizer="momentum", learning_rate=0.05, epochs=4,
+        batch_size=128, loss="softmax_xent", seed=0,
+        shuffle_each_epoch=False,  # deterministic batch composition
+        checkpoint_dir=ckpt_dir, checkpoint_every_steps=2)
+
+
+def main():
+    # env/backend setup lives here, NOT at module level: the test driver
+    # imports this module for make_data/trainer_config and must not have
+    # its own (8-device) backend configuration clobbered
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    out_dir = sys.argv[1]
+    from mmlspark_tpu.train import Trainer
+
+    pid = int(os.environ["MMLSPARK_TPU_PROCESS_ID"])
+    nproc = int(os.environ["MMLSPARK_TPU_NUM_PROCESSES"])
+    x, y = make_data()
+    # this process's data partition: a contiguous row block
+    rows = len(x) // nproc
+    x_local = x[pid * rows:(pid + 1) * rows]
+    y_local = y[pid * rows:(pid + 1) * rows]
+
+    ckpt_dir = os.path.join(out_dir, f"ckpt{pid}")
+    trainer = Trainer(trainer_config(ckpt_dir))  # initializes jax.distributed
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 4 * nproc
+
+    bundle = trainer.fit_arrays(x_local, y_local)
+
+    # restore path: only the coordinator has a checkpoint file on disk;
+    # non-coordinators receive the state via broadcast
+    state = trainer.init_state((1,) + x_local.shape[1:], 1)
+    restored = trainer.restore_checkpoint(state, ckpt_dir)
+    np.savez(
+        os.path.join(out_dir, f"result{pid}.npz"),
+        kernel=np.asarray(bundle.variables["params"]["dense0"]["kernel"]),
+        losses=np.asarray([h["loss"] for h in trainer.history]),
+        steps=bundle.metadata["steps"],
+        restored_step=int(restored.step),
+        restored_kernel=np.asarray(restored.params["dense0"]["kernel"]))
+    print(f"worker {pid} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,7 @@ from mmlspark_tpu.ml.train_regressor import TrainRegressor, TrainedRegressorMode
 from mmlspark_tpu.ml.statistics import (
     ComputeModelStatistics,
     ComputePerInstanceStatistics,
+    EvalResult,
 )
 from mmlspark_tpu.ml.find_best_model import BestModel, FindBestModel
 
@@ -31,6 +32,6 @@ __all__ = [
     "DecisionTreeRegressor", "RandomForestRegressor", "GBTRegressor",
     "TrainClassifier", "TrainedClassifierModel",
     "TrainRegressor", "TrainedRegressorModel",
-    "ComputeModelStatistics", "ComputePerInstanceStatistics",
+    "ComputeModelStatistics", "ComputePerInstanceStatistics", "EvalResult",
     "FindBestModel", "BestModel",
 ]

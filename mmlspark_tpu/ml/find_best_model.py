@@ -50,8 +50,8 @@ class FindBestModel(Estimator):
         best = None
         for model in self._models:
             scored = model.transform(table)
-            evaluator = ComputeModelStatistics()
-            metrics = evaluator.transform(scored)
+            result = ComputeModelStatistics().evaluate(scored)
+            metrics = result.metrics
             if col_name not in metrics:
                 raise ValueError(
                     f"metric '{metric}' not produced for model "
@@ -60,8 +60,8 @@ class FindBestModel(Estimator):
             rows.append({"model_name": model.uid,
                          **{c: float(metrics[c][0]) for c in metrics.columns}})
             if best is None or (value < best[1] if lower else value > best[1]):
-                best = (model, value, metrics, evaluator)
-        best_model, best_value, best_metrics, best_eval = best
+                best = (model, value, result)
+        best_model, best_value, best_result = best
         # models of different arities emit different metric columns (binary
         # AUC vs multiclass macro_*): take the union, NaN where absent
         all_cols: list[str] = []
@@ -70,9 +70,9 @@ class FindBestModel(Estimator):
                 if k not in all_cols:
                     all_cols.append(k)
         table_cols = {c: [r.get(c, np.nan) for r in rows] for c in all_cols}
-        return BestModel(best_model, best_metrics,
+        return BestModel(best_model, best_result.metrics,
                          DataTable(table_cols),
-                         roc=best_eval.last_roc,
+                         roc=best_result.roc,
                          evaluationMetric=metric)
 
 
@@ -104,9 +104,8 @@ class BestModel(Transformer):
     def get_roc_curve(self) -> DataTable:
         if self._roc is None:
             raise ValueError("best model produced no binary ROC")
-        fpr, tpr, thr = self._roc
-        return DataTable({"false_positive_rate": fpr,
-                          "true_positive_rate": tpr, "threshold": thr})
+        from mmlspark_tpu.ml.statistics import roc_table
+        return roc_table(self._roc)
 
     def transform(self, table: DataTable) -> DataTable:
         return self._best.transform(table)
@@ -117,6 +116,10 @@ class BestModel(Transformer):
             self._best_metrics.save(os.path.join(path, "best_metrics"))
         if self._all_metrics is not None:
             self._all_metrics.save(os.path.join(path, "all_metrics"))
+        if self._roc is not None:
+            np.savez(os.path.join(path, "roc.npz"),
+                     fpr=np.asarray(self._roc[0]), tpr=np.asarray(self._roc[1]),
+                     thresholds=np.asarray(self._roc[2]))
 
     def _load_extra(self, path: str) -> None:
         self._best = load_stage(os.path.join(path, "best"))
@@ -124,4 +127,9 @@ class BestModel(Transformer):
         am = os.path.join(path, "all_metrics")
         self._best_metrics = DataTable.load(bm) if os.path.exists(bm) else None
         self._all_metrics = DataTable.load(am) if os.path.exists(am) else None
-        self._roc = None
+        roc_path = os.path.join(path, "roc.npz")
+        if os.path.exists(roc_path):
+            z = np.load(roc_path)
+            self._roc = (z["fpr"], z["tpr"], z["thresholds"])
+        else:
+            self._roc = None

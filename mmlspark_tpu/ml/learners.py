@@ -175,6 +175,16 @@ def _fit_binary_lr(X, y, reg, max_iter, tol, fit_intercept):
     return w, (b if fit_intercept else jnp.zeros(()))
 
 
+def _fit_binary_lr_multi(X, Y, reg, max_iter, tol, fit_intercept):
+    """All K one-vs-rest fits as ONE vmapped L-BFGS: the per-class
+    objectives are identical in shape, so a single compile drives K lanes
+    on the same matmuls (vs the serial K compile+fit cycles a naive OvR
+    loop costs).  Y is (K, N); returns w (K, D), b (K,)."""
+    fit_one = lambda yk: _fit_binary_lr(X, yk, reg, max_iter, tol,
+                                        fit_intercept)
+    return jax.jit(jax.vmap(fit_one))(Y)
+
+
 class OneVsRestModel(ClassifierModel):
     def __init__(self, models: Optional[list] = None, **kw):
         super().__init__(**kw)
@@ -220,6 +230,19 @@ class OneVsRest(Estimator):
             raise ParamError("OneVsRest: no base classifier set")
         y = np.asarray(table[self.labelCol], np.int64)
         n_classes = int(y.max()) + 1 if len(y) else 0
+        if isinstance(self._classifier, LogisticRegression):
+            # fast path: one vmapped fit over all classes
+            base = self._classifier
+            X = _features_matrix(table[self.featuresCol])
+            Y = (y[None, :] == np.arange(n_classes)[:, None]).astype(np.float32)
+            w, b = _fit_binary_lr_multi(
+                jnp.asarray(X), jnp.asarray(Y), float(base.regParam),
+                int(base.maxIter), float(base.tol), bool(base.fitIntercept))
+            w, b = np.asarray(w), np.asarray(b)
+            models = [LogisticRegressionModel(w[k], float(b[k]),
+                                              featuresCol=self.featuresCol)
+                      for k in range(n_classes)]
+            return OneVsRestModel(models, featuresCol=self.featuresCol)
         models = []
         for k in range(n_classes):
             binary = table.with_column(self.labelCol,

@@ -10,6 +10,7 @@ names — the same contract the reference relies on
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import numpy as np
@@ -145,12 +146,41 @@ def auc_score(y_true: np.ndarray, scores: np.ndarray) -> float:
     return float(np.trapezoid(tpr, fpr))
 
 
+@dataclasses.dataclass
+class EvalResult:
+    """One evaluation, returned as a value (the data the reference logged
+    through MetricData, scala:486-521) — the evaluator itself stays
+    stateless, so concurrent/repeated use is safe."""
+
+    metrics: DataTable
+    confusion_matrix: Optional[np.ndarray] = None
+    roc: Optional[tuple] = None  # (fpr, tpr, thresholds)
+
+    def confusion_matrix_table(self) -> DataTable:
+        if self.confusion_matrix is None:
+            raise ValueError("no confusion matrix (regression evaluation?)")
+        cm = self.confusion_matrix
+        return DataTable({f"pred_{j}": cm[:, j] for j in range(cm.shape[1])})
+
+    def roc_curve_table(self) -> DataTable:
+        if self.roc is None:
+            raise ValueError("no binary ROC computed")
+        return roc_table(self.roc)
+
+
+def roc_table(roc: tuple) -> DataTable:
+    fpr, tpr, thr = roc
+    return DataTable({"false_positive_rate": np.asarray(fpr),
+                      "true_positive_rate": np.asarray(tpr),
+                      "threshold": np.asarray(thr)})
+
+
 class ComputeModelStatistics(Evaluator):
     """Emit a one-row metrics table for a scored table.
 
-    After transform, `last_confusion_matrix` and `last_roc` hold the
-    confusion matrix / ROC points of the evaluation (the data the reference
-    logged through MetricData, scala:486-521).
+    `evaluate` returns the full `EvalResult` (metrics + confusion matrix +
+    ROC); `transform` is the pipeline face and returns just the metrics
+    table.  Both are stateless.
     """
 
     evaluationMetric = Param(ALL_METRICS, "metric to compute ('all' or one "
@@ -159,12 +189,7 @@ class ComputeModelStatistics(Evaluator):
     labelCol = Param(None, "fallback true-label column when metadata has none",
                      ptype=str)
 
-    def __init__(self, **kw):
-        super().__init__(**kw)
-        self.last_confusion_matrix: Optional[np.ndarray] = None
-        self.last_roc: Optional[tuple] = None
-
-    def transform(self, table: DataTable) -> DataTable:
+    def evaluate(self, table: DataTable) -> EvalResult:
         kind, label, scores, scored_labels, probs = _schema_info(
             table, self.labelCol)
         metric = self.evaluationMetric
@@ -173,8 +198,11 @@ class ComputeModelStatistics(Evaluator):
         return self._classification(table, label, scores, scored_labels,
                                     probs, metric)
 
+    def transform(self, table: DataTable) -> DataTable:
+        return self.evaluate(table).metrics
+
     # -- regression (scala:186-203) --------------------------------------
-    def _regression(self, table, label, scores, metric) -> DataTable:
+    def _regression(self, table, label, scores, metric) -> EvalResult:
         y = np.asarray(table[label], np.float64)
         pred = np.asarray(table[scores], np.float64)
         err = y - pred
@@ -184,11 +212,11 @@ class ComputeModelStatistics(Evaluator):
                MAE_COL: float(np.mean(np.abs(err)))}
         if metric in REGRESSION_METRICS:
             out = {METRIC_TO_COLUMN[metric]: out[METRIC_TO_COLUMN[metric]]}
-        return DataTable({k: [v] for k, v in out.items()})
+        return EvalResult(DataTable({k: [v] for k, v in out.items()}))
 
     # -- classification (scala:143-185, 375-447) -------------------------
     def _classification(self, table, label, scores, scored_labels, probs,
-                        metric) -> DataTable:
+                        metric) -> EvalResult:
         pred_col = scored_labels or scores
         y = _label_indices(table, label, pred_col)
         yp = np.asarray(table[pred_col], np.float64).astype(np.int64)
@@ -197,7 +225,7 @@ class ComputeModelStatistics(Evaluator):
             levels.num_levels if levels is not None else 0,
             int(max(y.max(initial=0), yp.max(initial=0))) + 1, 2)
         cm = confusion_matrix(y, yp, n_classes)
-        self.last_confusion_matrix = cm
+        roc = None
 
         out: dict[str, float] = {}
         if n_classes == 2:
@@ -209,8 +237,9 @@ class ComputeModelStatistics(Evaluator):
             if probs is not None:
                 p = np.asarray(table[probs], np.float64)
                 pos = p[:, 1] if p.ndim == 2 else p
-                self.last_roc = roc_curve(y, pos)
-                out[AUC] = auc_score(y, pos)
+                roc = roc_curve(y, pos)
+                fpr, tpr, _ = roc
+                out[AUC] = float(np.trapezoid(tpr, fpr))
         else:
             # micro-averaged accuracy == overall accuracy; macro averages
             # per-class (scala:375-429)
@@ -230,20 +259,8 @@ class ComputeModelStatistics(Evaluator):
                                  "(scala:173)")
         if metric in CLASSIFICATION_METRICS and metric in out:
             out = {metric: out[metric]}
-        return DataTable({k: [v] for k, v in out.items()})
-
-    def confusion_matrix_table(self) -> DataTable:
-        cm = self.last_confusion_matrix
-        if cm is None:
-            raise ValueError("transform a scored table first")
-        return DataTable({f"pred_{j}": cm[:, j] for j in range(cm.shape[1])})
-
-    def roc_curve_table(self) -> DataTable:
-        if self.last_roc is None:
-            raise ValueError("no binary ROC computed yet")
-        fpr, tpr, thr = self.last_roc
-        return DataTable({"false_positive_rate": fpr,
-                          "true_positive_rate": tpr, "threshold": thr})
+        return EvalResult(DataTable({k: [v] for k, v in out.items()}),
+                          confusion_matrix=cm, roc=roc)
 
 
 class ComputePerInstanceStatistics(Evaluator):

@@ -49,7 +49,7 @@ class TPUModel(Transformer):
         super().__init__(**kwargs)
         self._bundle = bundle
         self._mesh = None
-        self._device_vars: dict[int, Any] = {}   # per-mesh replicated weights
+        self._device_vars: dict[Any, Any] = {}   # per-mesh replicated weights
         self._compiled: dict[tuple, Any] = {}    # per-(mesh, node) apply fns
 
     # -- model/mesh wiring ---------------------------------------------
@@ -117,16 +117,18 @@ class TPUModel(Transformer):
         """Mesh, replicated variables, and the compiled step (cached).
 
         Weights are replicated once per mesh; node selections share them
-        (only the compiled apply differs per node).
+        (only the compiled apply differs per node).  Caches key on the Mesh
+        itself (hashable, equality by devices+axes) — an `id()` key could
+        alias a dead mesh's entry to a new mesh after GC reuses the address.
         """
         if self._bundle is None:
             raise ValueError("TPUModel has no model bundle; call set_bundle()")
         mesh = self._get_mesh()
-        if id(mesh) not in self._device_vars:
-            self._device_vars[id(mesh)] = replicate_tree(
+        if mesh not in self._device_vars:
+            self._device_vars[mesh] = replicate_tree(
                 self._bundle.variables, mesh)
-        variables = self._device_vars[id(mesh)]
-        key = (id(mesh), self.outputNodeName, self.outputNodeIndex)
+        variables = self._device_vars[mesh]
+        key = (mesh, self.outputNodeName, self.outputNodeIndex)
         if key not in self._compiled:
             self._compiled[key] = self._make_apply(mesh, variables)
         return mesh, variables, self._compiled[key]

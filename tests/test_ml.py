@@ -43,6 +43,23 @@ def test_logistic_regression_binary():
     assert np.allclose(out["probability"].sum(axis=1), 1.0, atol=1e-5)
 
 
+def test_one_vs_rest_vmapped_matches_serial():
+    """The vmapped LR fast path must produce the same per-class models as
+    fitting each binary problem separately."""
+    from mmlspark_tpu.ml import OneVsRest
+
+    t = _blob_table(n=180, n_classes=3, seed=3)
+    ovr = OneVsRest(LogisticRegression(), featuresCol="feats",
+                    labelCol="mylabel").fit(t)
+    y = np.asarray(t["mylabel"], np.int64)
+    for k, m in enumerate(ovr._models):
+        binary = t.with_column("mylabel", (y == k).astype(np.float32))
+        ref = LogisticRegression(featuresCol="feats",
+                                 labelCol="mylabel").fit(binary)
+        np.testing.assert_allclose(m.w, ref.w, rtol=1e-3, atol=1e-4)
+        assert m.b == pytest.approx(ref.b, abs=1e-4)
+
+
 def test_linear_regression_recovers_coefficients():
     rng = np.random.default_rng(0)
     X = rng.normal(size=(200, 3)).astype(np.float32)
@@ -145,15 +162,18 @@ def test_compute_model_statistics_binary():
     t = _blob_table(label_vals=["neg", "pos"])
     model = TrainClassifier(LogisticRegression(), labelCol="mylabel").fit(t)
     scored = model.transform(t)
-    ev = ComputeModelStatistics()
-    m = ev.transform(scored)
+    res = ComputeModelStatistics().evaluate(scored)
+    m = res.metrics
     assert float(m["accuracy"][0]) > 0.95
     assert float(m["AUC"][0]) > 0.95
     assert 0 <= float(m["precision"][0]) <= 1
-    cm = ev.last_confusion_matrix
+    cm = res.confusion_matrix
     assert cm.shape == (2, 2) and cm.sum() == t.num_rows
-    roc = ev.roc_curve_table()
+    roc = res.roc_curve_table()
     assert roc["true_positive_rate"][len(roc) - 1] == 1.0
+    # transform stays the stateless pipeline face returning just metrics
+    m2 = ComputeModelStatistics().transform(scored)
+    assert float(m2["accuracy"][0]) == float(m["accuracy"][0])
 
 
 def test_compute_model_statistics_multiclass():

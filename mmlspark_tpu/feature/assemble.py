@@ -29,22 +29,23 @@ from mmlspark_tpu.core.pipeline import Estimator, Pipeline, PipelineModel, Trans
 from mmlspark_tpu.core.schema import ColumnMeta
 from mmlspark_tpu.core.table import DataTable, object_column
 from mmlspark_tpu.feature.hashing import (densify_sparse_column,
-                                          nonzero_slots, sparse_count_row)
+                                          hash_token_lists, nonzero_slots)
 
 # 2^18 slots by default; 2^12 for tree/NN learners (Featurize.scala:13-19)
 NUM_FEATURES_DEFAULT = 1 << 18
 NUM_FEATURES_TREE_OR_NN = 1 << 12
 
 
-def _tokenize_strings(values) -> list[str]:
-    """Lowercase whitespace tokenization over one row's string cells
+def _tokenize_string_columns(cols_data, n: int) -> list[list[str]]:
+    """Per-row token lists over several string columns, one pass per column
     (reference hashStringColumns, AssembleFeatures.scala:46-53)."""
-    toks: list[str] = []
-    for v in values:
-        if v is None or v == "":
-            continue
-        toks.extend(str(v).lower().split())
-    return toks
+    row_tokens: list[list[str]] = [[] for _ in range(n)]
+    for cd in cols_data:
+        for i, v in enumerate(cd):
+            if v is None or v == "":
+                continue
+            row_tokens[i].extend(str(v).lower().split())
+    return row_tokens
 
 
 class AssembleFeatures(Estimator):
@@ -110,10 +111,8 @@ class AssembleFeatures(Estimator):
         if hash_cols:
             nf = self.numberOfFeatures
             cols_data = [table[c] for c in hash_cols]
-            fit_rows = [
-                sparse_count_row(
-                    _tokenize_strings([cd[i] for cd in cols_data]), nf)
-                for i in range(table.num_rows)]
+            fit_rows = hash_token_lists(
+                _tokenize_string_columns(cols_data, table.num_rows), nf)
             selected = nonzero_slots(fit_rows)
 
         model = AssembleFeaturesModel(
@@ -240,9 +239,8 @@ class AssembleFeaturesModel(Transformer):
                 rows = cache[1]
             if rows is None:
                 cols_data = [kept[c] for c in self._hash_cols]
-                rows = [sparse_count_row(
-                            _tokenize_strings([cd[i] for cd in cols_data]), nf)
-                        for i in range(n)]
+                rows = hash_token_lists(
+                    _tokenize_string_columns(cols_data, n), nf)
             parts.append(densify_sparse_column(object_column(rows),
                                                selected=self._selected))
 

@@ -22,7 +22,7 @@ from mmlspark_tpu.core.params import Param, domain
 from mmlspark_tpu.core.pipeline import (Estimator, PipelineModel, Transformer,
                                         load_stage)
 from mmlspark_tpu.core.table import DataTable, object_column as _object_column
-from mmlspark_tpu.feature.hashing import sparse_count_row
+from mmlspark_tpu.feature.hashing import concat_sparse_rows, hash_token_lists
 
 # A standard English stop-word list (the usual Porter/SMART subset Spark's
 # loadDefaultStopWords("english") ships; reference TextFeaturizer.scala:245-253).
@@ -125,8 +125,7 @@ class HashingTF(Transformer):
     def transform(self, table: DataTable) -> DataTable:
         self._check_required()
         nf, binary = self.numFeatures, self.binary
-        rows = [sparse_count_row(toks, nf, binary)
-                for toks in table[self.inputCol]]
+        rows = hash_token_lists(list(table[self.inputCol]), nf, binary)
         out = table.with_column(self.outputCol, _object_column(rows))
         meta = out.meta(self.outputCol)
         meta.extra.update(num_features=nf, sparse=True)
@@ -150,13 +149,24 @@ class IDFModel(Transformer):
 
     def transform(self, table: DataTable) -> DataTable:
         self._check_required()
-        idf = self._idf
-        default = self._default
-        rows = []
-        for sl_idx, vals in table[self.inputCol]:
-            w = np.asarray([idf.get(int(i), default) for i in sl_idx],
-                           np.float32)
-            rows.append((sl_idx, vals * w))
+        col = table[self.inputCol]
+        # one vectorized weight lookup over the concatenated corpus
+        slots = np.fromiter(self._idf.keys(), np.int64, len(self._idf))
+        order = np.argsort(slots)
+        slots = slots[order]
+        weights = np.fromiter(self._idf.values(), np.float32,
+                              len(self._idf))[order]
+        row_ids, indices, values = concat_sparse_rows(col)
+        w = np.full(len(indices), self._default, np.float32)
+        if len(slots) and len(indices):
+            pos = np.searchsorted(slots, indices)
+            ok = ((pos < len(slots))
+                  & (slots[np.minimum(pos, len(slots) - 1)] == indices))
+            w[ok] = weights[pos[ok]]
+        weighted = values * w
+        bounds = np.searchsorted(row_ids, np.arange(len(col) + 1))
+        rows = [(col[i][0], weighted[bounds[i]:bounds[i + 1]])
+                for i in range(len(col))]
         out = table.with_column(self.outputCol, _object_column(rows))
         meta = table.meta(self.inputCol).copy()
         out.set_meta(self.outputCol, meta)
@@ -186,15 +196,16 @@ class IDF(Estimator):
 
     def fit(self, table: DataTable) -> IDFModel:
         self._check_required()
-        df: dict[int, int] = {}
         col = table[self.inputCol]
-        for sl_idx, _ in col:
-            for i in sl_idx:
-                df[int(i)] = df.get(int(i), 0) + 1
+        # indices are unique within a row, so corpus-wide slot counts ARE
+        # document frequencies — one np.unique over the concatenation
+        _, indices, _ = concat_sparse_rows(col)
+        slots, counts = np.unique(indices, return_counts=True)
         n = len(col)
         min_df = self.minDocFreq
-        idf = {slot: float(np.log((n + 1.0) / (cnt + 1.0)))
-               for slot, cnt in df.items() if cnt >= min_df}
+        keep = counts >= min_df
+        log_w = np.log((n + 1.0) / (counts[keep] + 1.0))
+        idf = {int(s): float(v) for s, v in zip(slots[keep], log_w)}
         default = float(np.log(n + 1.0)) if min_df <= 0 else 0.0
         return IDFModel(idf, default_weight=default,
                         inputCol=self.inputCol, outputCol=self.outputCol)

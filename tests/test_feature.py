@@ -61,6 +61,24 @@ def test_hashing_tf_counts_stable():
     assert out.meta("tf").extra["num_features"] == 64
 
 
+def test_bulk_hashing_matches_per_row():
+    """hash_token_lists is the bulk path; it must reproduce
+    sparse_count_row exactly, row by row."""
+    from mmlspark_tpu.feature.hashing import hash_token_lists, sparse_count_row
+
+    rng = np.random.default_rng(0)
+    vocab = [f"w{i}" for i in range(50)]
+    lists = [[vocab[j] for j in rng.integers(0, 50, rng.integers(0, 12))]
+             for _ in range(200)]
+    for binary in (False, True):
+        bulk = hash_token_lists(lists, 64, binary)
+        assert len(bulk) == 200
+        for toks, (bi, bv) in zip(lists, bulk):
+            ri, rv = sparse_count_row(toks, 64, binary)
+            np.testing.assert_array_equal(bi, ri)
+            np.testing.assert_array_equal(bv, rv)
+
+
 def test_idf_downweights_common_terms():
     t = DataTable({"tok": [["common", "rare1"], ["common", "rare2"],
                            ["common", "rare3"]]})

@@ -119,10 +119,41 @@ class ResNetBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+class ResNetBottleneckBlock(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand (x4), the ResNet-50/101/152 block."""
+
+    filters: int                       # bottleneck width; output is 4x this
+    strides: tuple[int, int] = (1, 1)
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(4 * self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(4 * self.filters, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
 class ResNet(nn.Module, NodeMixin):
     """ResNet image featurizer (the zoo's ResNet50-class models,
     ImageFeaturizerSuite.scala:45-53 asserts a 1000-wide output).
 
+    block_kind 'basic' gives the 18/34 layouts; 'bottleneck' the 50/101/152
+    layouts (widths are the bottleneck widths; stage outputs are 4x).
     Named nodes: stem, stage1..stageN, pool (global average — the transfer-
     learning feature layer), z (classifier logits).
     """
@@ -130,10 +161,13 @@ class ResNet(nn.Module, NodeMixin):
     stage_sizes: Sequence[int] = (2, 2, 2, 2)  # ResNet-18 layout
     widths: Sequence[int] = (64, 128, 256, 512)
     num_classes: int = 1000
+    block_kind: str = "basic"          # basic | bottleneck
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        block_cls = {"basic": ResNetBlock,
+                     "bottleneck": ResNetBottleneckBlock}[self.block_kind]
         x = x.astype(self.dtype)
         x = nn.Conv(64, (7, 7), (2, 2), padding="SAME", use_bias=False,
                     dtype=self.dtype, name="stem_conv")(x)
@@ -143,12 +177,20 @@ class ResNet(nn.Module, NodeMixin):
         for i, (n_blocks, w) in enumerate(zip(self.stage_sizes, self.widths), 1):
             for b in range(n_blocks):
                 strides = (2, 2) if b == 0 and i > 1 else (1, 1)
-                x = ResNetBlock(w, strides, dtype=self.dtype)(x, train)
+                x = block_cls(w, strides, dtype=self.dtype)(x, train)
             x = self.node(f"stage{i}", x)
         x = jnp.mean(x, axis=(1, 2))
         x = self.node("pool", x.astype(jnp.float32))
         z = nn.Dense(self.num_classes, dtype=self.dtype, name="out")(x)
         return self.node("z", z.astype(jnp.float32))
+
+
+def resnet50(num_classes: int = 1000, dtype: Dtype = jnp.bfloat16) -> "ResNet":
+    """The canonical ResNet-50 (the reference zoo's headline featurizer,
+    ModelDownloader CDN models; pool node is 2048-dim)."""
+    return ResNet(stage_sizes=(3, 4, 6, 3), widths=(64, 128, 256, 512),
+                  num_classes=num_classes, block_kind="bottleneck",
+                  dtype=dtype)
 
 
 class TransformerBlock(nn.Module):

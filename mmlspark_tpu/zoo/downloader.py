@@ -124,7 +124,13 @@ class LocalRepo:
         for name in sorted(os.listdir(self.path)):
             if name.endswith(".meta"):
                 with open(os.path.join(self.path, name)) as f:
-                    out.append(ModelSchema.from_json(json.load(f)))
+                    schema = ModelSchema.from_json(json.load(f))
+                # metas store payload URIs relative to the repo dir (the
+                # portable CDN layout); resolve for local reads
+                if "://" not in schema.uri and not os.path.isabs(schema.uri):
+                    schema = dataclasses.replace(
+                        schema, uri=os.path.join(self.path, schema.uri))
+                out.append(schema)
         return out
 
     def get_payload(self, schema: ModelSchema) -> bytes:
@@ -151,8 +157,23 @@ class LocalRepo:
             numLayers=len(meta.get("layer_names", [])),
             layerNames=list(meta.get("layer_names", [])))
         with open(payload + ".meta", "w") as f:
-            json.dump(schema.to_json(), f, indent=1)
+            # portable layout: the stored URI is relative to the repo dir,
+            # so the directory can be served over HTTP (export_manifest) or
+            # moved; the returned schema carries the resolved absolute path
+            json.dump({**schema.to_json(),
+                       "uri": os.path.basename(payload)}, f, indent=1)
         return schema
+
+    def export_manifest(self) -> str:
+        """Write a MANIFEST listing the repo's .meta names, making the
+        directory directly servable over HTTP for RemoteRepo (the
+        reference's CDN layout, ModelDownloader.scala:109-157)."""
+        metas = [n for n in sorted(os.listdir(self.path))
+                 if n.endswith(".meta")]
+        path = os.path.join(self.path, "MANIFEST")
+        with open(path, "w") as f:
+            f.write("\n".join(metas) + "\n")
+        return path
 
 
 class RemoteRepo:
@@ -269,24 +290,32 @@ _BUILTIN_SPECS = [
     ("ResNet18", "ImageNet", "ResNet",
      {"stage_sizes": [2, 2, 2, 2], "widths": [64, 128, 256, 512]},
      [1, 224, 224, 3], ["z", "pool", "stage4", "stage3", "stage2", "stage1"]),
+    ("ResNet50", "ImageNet", "ResNet",
+     {"stage_sizes": [3, 4, 6, 3], "widths": [64, 128, 256, 512],
+      "block_kind": "bottleneck"},
+     [1, 224, 224, 3], ["z", "pool", "stage4", "stage3", "stage2", "stage1"]),
     ("MLP", "Generic", "MLPClassifier", {"hidden_sizes": [100]},
      [1, 16], ["z", "h0"]),
 ]
 
 
-def create_builtin_repo(path: str, seed: int = 0) -> LocalRepo:
+def create_builtin_repo(path: str, seed: int = 0,
+                        include: Optional[list] = None) -> LocalRepo:
     """Materialize the built-in architecture zoo as a local repo.
 
     Weights are seed-initialized (the reference's zoo ships pretrained CNTK
     graphs from a CDN, tools/config.sh; in an air-gapped build the zoo
     carries architectures + integrity plumbing, and fine-tuning fills in
-    weights via train/).
+    weights via train/).  `include` limits materialization to the named
+    models (big nets like ResNet50 take seconds to init + pack).
     """
     from mmlspark_tpu.models.definitions import build_model
     repo = LocalRepo(path)
     existing = {(s.name, s.dataset) for s in repo.list_schemas()}
     for name, dataset, arch, config, input_shape, layer_names in _BUILTIN_SPECS:
         if (name, dataset) in existing:
+            continue
+        if include is not None and name not in include:
             continue
         module = build_model(arch, config)
         bundle = ModelBundle.init(module, tuple(input_shape), seed=seed,

@@ -79,18 +79,105 @@ def test_download_unknown_model(tmp_path, source_repo):
 
 
 def test_builtin_repo(tmp_path):
-    repo = create_builtin_repo(str(tmp_path / "zoo"))
+    include = ["ConvNet", "ResNet18", "MLP"]
+    repo = create_builtin_repo(str(tmp_path / "zoo"), include=include)
     names = {s.name for s in repo.list_schemas()}
     assert {"ConvNet", "ResNet18", "MLP"} <= names
     # idempotent
-    create_builtin_repo(str(tmp_path / "zoo"))
+    create_builtin_repo(str(tmp_path / "zoo"), include=include)
     assert len(list(repo.list_schemas())) == 3
+    # the full catalogue carries the ResNet-50 headliner
+    from mmlspark_tpu.zoo.downloader import _BUILTIN_SPECS
+    assert "ResNet50" in {s[0] for s in _BUILTIN_SPECS}
+
+
+def test_resnet50_bottleneck_shapes():
+    """The canonical ResNet-50: 2048-dim pool features, 1000-dim logits
+    (reference ImageFeaturizerSuite.scala:45-53 asserts the 1000-dim
+    output).  Checked abstractly via eval_shape — no weights materialized."""
+    import jax
+    from mmlspark_tpu.models.definitions import resnet50
+
+    module = resnet50()
+    x = jax.ShapeDtypeStruct((1, 224, 224, 3), np.float32)
+    variables = jax.eval_shape(module.init, jax.random.key(0), x)
+    out, state = jax.eval_shape(
+        lambda v, xx: module.apply(v, xx, mutable=["intermediates"]),
+        variables, x)
+    assert out.shape == (1, 1000)
+    inter = state["intermediates"]
+    assert inter["pool"][0].shape == (1, 2048)
+    assert inter["stage4"][0].shape == (1, 7, 7, 2048)
+
+
+def test_fine_tune_publish_serve_download_featurize(tmp_path):
+    """The full zoo loop over a real HTTP server: fine-tune (TPULearner) ->
+    publish (LocalRepo.add_model + export_manifest) -> download via
+    RemoteRepo -> ImageFeaturizer with the 1000-dim assertion (reference
+    ModelDownloader.scala:109-157 + ImageFeaturizerSuite.scala:45-53)."""
+    import http.server
+    import threading
+
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.train import TPULearner, TrainerConfig
+    from mmlspark_tpu.vision import ImageFeaturizer
+    from mmlspark_tpu.zoo import RemoteRepo
+
+    # 1) fine-tune a (tiny) bottleneck ResNet with a 1000-class head
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, size=(16, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, size=16).astype(np.int32)
+    cfg = TrainerConfig(
+        architecture="ResNet",
+        model_config={"stage_sizes": [1, 1, 1, 1], "widths": [4, 4, 4, 4],
+                      "block_kind": "bottleneck", "num_classes": 1000,
+                      "dtype": "float32"},
+        optimizer="sgd", learning_rate=0.01, epochs=1, batch_size=8, seed=0)
+    model = TPULearner(cfg).fit(
+        DataTable({"features": images, "label": labels}))
+    bundle = model.bundle
+    bundle.metadata.update(
+        input_shape=[1, 32, 32, 3],
+        layer_names=["z", "pool", "stage4", "stage3", "stage2", "stage1"])
+
+    # 2) publish + manifest
+    repo = LocalRepo(str(tmp_path / "serve"))
+    repo.add_model(bundle, "TinyResNet50", "e2e")
+    repo.export_manifest()
+
+    # 3) serve the repo dir over HTTP
+    handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(
+        *a, directory=repo.path, **kw)
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        remote = RemoteRepo(base)
+        schemas = list(remote.list_schemas())
+        assert [s.name for s in schemas] == ["TinyResNet50"]
+
+        # 4) download (verified) + featurize
+        dl = ModelDownloader(str(tmp_path / "cache"))
+        local = dl.download_by_name(remote, "TinyResNet50")
+        fetched = dl.load_bundle(local)
+        t = DataTable({"image": rng.integers(0, 255, size=(4, 32, 32, 3),
+                                             dtype=np.uint8)})
+        feats = ImageFeaturizer(fetched, inputCol="image", outputCol="f",
+                                cutOutputLayers=1).transform(t)
+        assert feats["f"].shape == (4, 16)  # pool: 4x bottleneck width 4
+        logits = ImageFeaturizer(fetched, inputCol="image", outputCol="f",
+                                 cutOutputLayers=0).transform(t)
+        assert logits["f"].shape == (4, 1000)  # the 1000-dim assertion
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
 
 
 def test_zoo_feeds_image_featurizer(tmp_path):
     from mmlspark_tpu import DataTable
     from mmlspark_tpu.vision import ImageFeaturizer
-    repo = create_builtin_repo(str(tmp_path / "zoo"))
+    repo = create_builtin_repo(str(tmp_path / "zoo"), include=["ConvNet"])
     dl = ModelDownloader(str(tmp_path / "cache"))
     schema = dl.download_by_name(repo, "ConvNet")
     bundle = dl.load_bundle(schema)

@@ -1,0 +1,72 @@
+"""101 - Adult Census Income Training.
+
+Mirrors the reference's notebook 101 (`notebooks/samples/101 - Adult Census
+Income Training.ipynb`): train classifiers over a mixed-type census-like
+table with `TrainClassifier` doing all featurization implicitly, compare
+every learner family with `FindBestModel`, and evaluate the winner with
+`ComputeModelStatistics`.  Runs on a deterministic synthetic census
+(utils/demo_data.py) because this build is air-gapped.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.ml import (
+    ComputeModelStatistics,
+    DecisionTreeClassifier,
+    FindBestModel,
+    GBTClassifier,
+    LogisticRegression,
+    MultilayerPerceptronClassifier,
+    NaiveBayes,
+    RandomForestClassifier,
+    TrainClassifier,
+)
+from mmlspark_tpu.utils.demo_data import adult_census_like
+
+
+def main(verbose: bool = True) -> dict:
+    log = print if verbose else (lambda *a, **k: None)
+    data = adult_census_like(n=600, seed=0)
+    n_train = 450
+    train = data.slice(0, n_train)
+    test = data.slice(n_train, data.num_rows)
+    log(f"census-like data: {data.num_rows} rows, "
+        f"columns {list(data.columns)}")
+
+    # every learner family of the reference grid
+    # (TrainClassifier.scala:74-110)
+    learners = {
+        "LogisticRegression": LogisticRegression(),
+        "DecisionTree": DecisionTreeClassifier(maxDepth=5),
+        "RandomForest": RandomForestClassifier(numTrees=10, maxDepth=5),
+        "GBT": GBTClassifier(maxIter=10, maxDepth=4),
+        "NaiveBayes": NaiveBayes(),
+        "MLP": MultilayerPerceptronClassifier(layers=[-1, 32, -1],
+                                              maxIter=40),
+    }
+    models = {name: TrainClassifier(learner, labelCol="income").fit(train)
+              for name, learner in learners.items()}
+
+    best = FindBestModel(list(models.values()),
+                         evaluationMetric="accuracy").fit(test)
+    comparison = best.get_all_model_metrics()
+    log("model comparison (test accuracy):")
+    for i in range(len(comparison["model_name"])):
+        log(f"  {comparison['model_name'][i]}: "
+            f"{float(comparison['accuracy'][i]):.3f}")
+
+    scored = best.transform(test)
+    result = ComputeModelStatistics().evaluate(scored)
+    metrics = {c: float(result.metrics[c][0]) for c in result.metrics.columns}
+    log(f"best model metrics: { {k: round(v, 4) for k, v in metrics.items()} }")
+    return {
+        "accuracies": {name: float(
+            ComputeModelStatistics().transform(m.transform(test))["accuracy"][0])
+            for name, m in models.items()},
+        "best_metrics": metrics,
+        "confusion_matrix": result.confusion_matrix,
+    }
+
+
+if __name__ == "__main__":
+    main()

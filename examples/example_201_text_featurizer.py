@@ -1,0 +1,46 @@
+"""201 - Book reviews with TextFeaturizer.
+
+Mirrors the reference's notebook 201 (`notebooks/samples/201 - Amazon Book
+Reviews - TextFeaturizer.ipynb`): featurize free text with the
+TextFeaturizer chain (tokenize -> stop words -> n-grams -> hashing TF ->
+IDF), densify, and train a classifier on the result.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.feature import TextFeaturizer, densify_sparse_column
+from mmlspark_tpu.ml import ComputeModelStatistics, LogisticRegression, TrainClassifier
+from mmlspark_tpu.utils.demo_data import book_reviews_like
+
+
+def main(verbose: bool = True) -> dict:
+    log = print if verbose else (lambda *a, **k: None)
+    data = book_reviews_like(n=400, seed=2)
+    n_train = 300
+    train = data.slice(0, n_train)
+    test = data.slice(n_train, data.num_rows)
+    log(f"book-review-like data: {data.num_rows} rows; "
+        f"sample: {train['text'][0][:60]!r}")
+
+    featurizer = TextFeaturizer(
+        inputCol="text", outputCol="feats",
+        useStopWordsRemover=True, useIDF=True,
+        numFeatures=1 << 14).fit(train)
+
+    def densify(t):
+        out = featurizer.transform(t)
+        dense = densify_sparse_column(
+            out["feats"], num_features=1 << 14)
+        # keep only the label + dense features for training
+        return out.drop("feats", "text").with_column("feats", dense)
+
+    model = TrainClassifier(LogisticRegression(), labelCol="rating").fit(
+        densify(train))
+    metrics = ComputeModelStatistics().transform(model.transform(densify(test)))
+    out = {c: float(metrics[c][0]) for c in metrics.columns}
+    log(f"test metrics: { {k: round(v, 4) for k, v in out.items()} }")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""202 - Book reviews with Word2Vec embeddings.
+
+Mirrors the reference's notebook 202 (`notebooks/samples/202 - Amazon Book
+Reviews - Word2Vec.ipynb`): tokenize reviews, fit Word2Vec skip-gram
+embeddings, represent each review as its mean word vector, and train a
+classifier on the embedded documents.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.feature import Tokenizer, Word2Vec
+from mmlspark_tpu.ml import ComputeModelStatistics, LogisticRegression, TrainClassifier
+from mmlspark_tpu.utils.demo_data import book_reviews_like
+
+
+def main(verbose: bool = True) -> dict:
+    log = print if verbose else (lambda *a, **k: None)
+    data = book_reviews_like(n=400, seed=2)
+    tokens = Tokenizer(inputCol="text", outputCol="tokens").transform(data)
+
+    w2v = Word2Vec(inputCol="tokens", outputCol="embedding",
+                   vectorSize=32, windowSize=4, minCount=3,
+                   maxIter=3, seed=0).fit(tokens)
+    log(f"vocabulary: {len(w2v.vocabulary)} words")
+    synonyms = w2v.find_synonyms("great", 3)
+    log(f"synonyms of 'great': {[(w, round(s, 3)) for w, s in synonyms]}")
+
+    embedded = w2v.transform(tokens).drop("text", "tokens")
+    train = embedded.slice(0, 300)
+    test = embedded.slice(300, embedded.num_rows)
+    model = TrainClassifier(LogisticRegression(), labelCol="rating").fit(train)
+    metrics = ComputeModelStatistics().transform(model.transform(test))
+    out = {c: float(metrics[c][0]) for c in metrics.columns}
+    log(f"test metrics: { {k: round(v, 4) for k, v in out.items()} }")
+    out["n_vocab"] = len(w2v.vocabulary)
+    out["top_synonym"] = synonyms[0][0]
+    return out
+
+
+if __name__ == "__main__":
+    main()

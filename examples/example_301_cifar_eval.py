@@ -1,0 +1,78 @@
+"""301 - CIFAR-10 ConvNet evaluation.
+
+Mirrors the reference's notebook 301 (`notebooks/samples/301 - CIFAR10 CNTK
+CNN Evaluation.ipynb`): load the zoo ConvNet, score an image table through
+TPUModel (the CNTKModel counterpart), and evaluate with
+ComputeModelStatistics including the confusion matrix.  The reference
+downloaded a pretrained CNTK graph; air-gapped here, the zoo model is
+fine-tuned on the synthetic set first (train/ is the cntk-train
+counterpart), then evaluated exactly as the notebook does — the notebook's
+timed scoring loop becomes the bench.py throughput measurement.
+"""
+
+import time
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import SchemaConstants, set_score_column
+from mmlspark_tpu.ml import ComputeModelStatistics
+from mmlspark_tpu.models import TPUModel
+from mmlspark_tpu.train import TPULearner, TrainerConfig
+from mmlspark_tpu.utils.demo_data import cifar_like
+from mmlspark_tpu.zoo import ModelDownloader, create_builtin_repo
+
+
+def main(verbose: bool = True, out_dir: str = "/tmp/mmlspark_tpu_zoo") -> dict:
+    log = print if verbose else (lambda *a, **k: None)
+    data = cifar_like(n=512, seed=3)
+    n_train = 384
+    train = data.slice(0, n_train)
+    test = data.slice(n_train, data.num_rows)
+
+    # zoo model (downloader counterpart)
+    repo = create_builtin_repo(out_dir, include=["ConvNet"])
+    dl = ModelDownloader(f"{out_dir}_cache")
+    schema = dl.download_by_name(repo, "ConvNet")
+    bundle = dl.load_bundle(schema)
+    log(f"zoo model: {schema.name} ({schema.size} bytes, "
+        f"layers {schema.layerNames})")
+
+    # fine-tune on the synthetic classes
+    cfg = TrainerConfig(
+        architecture=bundle.architecture,
+        model_config=bundle.config,
+        optimizer="momentum", learning_rate=0.003, epochs=6, batch_size=64,
+        loss="softmax_xent", seed=0)
+    features = train["image"].astype(np.float32) / 255.0
+    model = TPULearner(cfg).set_initial_bundle(bundle).fit(
+        train.drop("image", "label")
+             .with_column("features", features)
+             .with_column("label", np.asarray(train["label"], np.int32)))
+
+    # score the eval set (the notebook's timed loop)
+    scorer = TPUModel(model.bundle, inputCol="image", outputCol="scores",
+                      miniBatchSize=128)
+    t0 = time.perf_counter()
+    scored = scorer.transform(
+        test.with_column("image", test["image"].astype(np.float32) / 255.0))
+    wall = time.perf_counter() - t0
+    preds = np.argmax(scored["scores"], axis=1).astype(np.float64)
+    scored = scored.with_column("prediction", preds)
+    set_score_column(scored, "example301", "prediction",
+                     SchemaConstants.SCORED_LABELS_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    set_score_column(scored, "example301", "label",
+                     SchemaConstants.TRUE_LABELS_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+
+    result = ComputeModelStatistics().evaluate(scored)
+    acc = float(result.metrics["accuracy"][0])
+    log(f"eval: {test.num_rows} images in {wall:.2f}s "
+        f"({test.num_rows / wall:.0f} img/s), accuracy={acc:.3f}")
+    log(f"confusion matrix diag: {np.diag(result.confusion_matrix)}")
+    return {"accuracy": acc, "images_per_s": test.num_rows / wall,
+            "confusion_matrix": result.confusion_matrix}
+
+
+if __name__ == "__main__":
+    main()

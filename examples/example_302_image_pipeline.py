@@ -1,0 +1,78 @@
+"""302 - Pipeline image transformations + transfer learning.
+
+Mirrors the reference's notebook 302 (`notebooks/samples/302 - Pipeline
+Image Transformations.ipynb`): read images from disk (`read_images`, the
+readImages counterpart), run batched ImageTransformer ops (resize, crop,
+flip — the OpenCV stage pipeline), featurize with a truncated zoo model
+(ImageFeaturizer), and train a classifier on the features.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from mmlspark_tpu.io import read_images
+from mmlspark_tpu.ml import ComputeModelStatistics, LogisticRegression, TrainClassifier
+from mmlspark_tpu.utils.demo_data import cifar_like
+from mmlspark_tpu.vision import ImageFeaturizer, ImageTransformer
+from mmlspark_tpu.zoo import ModelDownloader, create_builtin_repo
+
+
+def _write_image_dir(root: str, n: int = 96) -> int:
+    """Materialize a synthetic 2-class image directory tree (the notebook
+    reads a folder of files)."""
+    from PIL import Image
+    data = cifar_like(n=n, seed=5, n_classes=2)
+    labels = np.asarray(data["label"], np.int64)
+    for i in range(n):
+        cls_dir = os.path.join(root, f"class{labels[i]}")
+        os.makedirs(cls_dir, exist_ok=True)
+        arr = data["image"][i][:, :, ::-1]  # BGR -> RGB for PIL
+        Image.fromarray(arr).save(os.path.join(cls_dir, f"img{i:03d}.png"))
+    return n
+
+
+def main(verbose: bool = True) -> dict:
+    log = print if verbose else (lambda *a, **k: None)
+    with tempfile.TemporaryDirectory() as root:
+        n = _write_image_dir(root, n=96)
+
+        # read the directory tree (readImages counterpart)
+        table = read_images(root, recursive=True)
+        log(f"read {table.num_rows}/{n} images "
+            f"-> dense tensor {table['image'].shape}")
+        labels = np.asarray(
+            [0.0 if "class0" in p else 1.0 for p in table["path"]])
+        table = table.with_column("label", labels)
+
+        # batched transformer ops (the OpenCV stage pipeline)
+        transformed = (ImageTransformer(inputCol="image", outputCol="image")
+                       .resize(40, 40).center_crop(32, 32).flip()
+                       .transform(table))
+        assert transformed["image"].shape[1:] == (32, 32, 3)
+
+        # transfer learning via the zoo ConvNet's dense1 features
+        repo = create_builtin_repo(os.path.join(root, "zoo"),
+                                   include=["ConvNet"])
+        dl = ModelDownloader(os.path.join(root, "cache"))
+        bundle = dl.load_bundle(dl.download_by_name(repo, "ConvNet"))
+        feats = ImageFeaturizer(bundle, inputCol="image",
+                                outputCol="features",
+                                cutOutputLayers=1).transform(transformed)
+        log(f"featurized: {feats['features'].shape}")
+
+        train = feats.slice(0, 72)
+        test = feats.slice(72, feats.num_rows)
+        model = TrainClassifier(LogisticRegression(), labelCol="label").fit(
+            train.drop("image", "path"))
+        metrics = ComputeModelStatistics().transform(
+            model.transform(test.drop("image", "path")))
+        acc = float(metrics["accuracy"][0])
+        log(f"transfer-learning accuracy: {acc:.3f}")
+        return {"n_images": table.num_rows, "accuracy": acc,
+                "feature_dim": feats["features"].shape[1]}
+
+
+if __name__ == "__main__":
+    main()

@@ -11,9 +11,11 @@ from mmlspark_tpu.feature.text import (
     Tokenizer,
 )
 from mmlspark_tpu.feature.hashing import densify_sparse_column, stable_hash
+from mmlspark_tpu.feature.word2vec import Word2Vec, Word2VecModel
 
 __all__ = [
     "AssembleFeatures", "AssembleFeaturesModel", "Featurize",
     "Tokenizer", "StopWordsRemover", "NGram", "HashingTF", "IDF", "IDFModel",
     "TextFeaturizer", "stable_hash", "densify_sparse_column",
+    "Word2Vec", "Word2VecModel",
 ]

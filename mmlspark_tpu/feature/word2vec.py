@@ -1,0 +1,183 @@
+"""Word2Vec: skip-gram embeddings with negative sampling.
+
+Counterpart of Spark's Word2Vec as used by the reference's notebook 202
+(`notebooks/samples/202 - Amazon Book Reviews - Word2Vec.ipynb`): fit token
+embeddings on a corpus, then represent each document as the mean of its
+word vectors (Spark's Word2VecModel.transform semantics).
+
+TPU-first design: pair generation (center/context windows, unigram^0.75
+negative table) is one vectorized host pass; training is a single jitted
+optax step over embedding lookups — all batches have one static shape, so
+XLA compiles once and the MXU sees only gathers + batched dot products.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Estimator, Transformer
+from mmlspark_tpu.core.table import DataTable
+
+
+class Word2VecModel(Transformer):
+    """Document vectors = mean of fitted word vectors (Spark semantics)."""
+
+    inputCol = Param(None, "token-list column", ptype=str, required=True)
+    outputCol = Param("w2v", "document-vector output column", ptype=str)
+
+    def __init__(self, vocab: Optional[list[str]] = None,
+                 vectors: Optional[np.ndarray] = None, **kw):
+        super().__init__(**kw)
+        self._vocab = list(vocab or [])
+        self._index = {w: i for i, w in enumerate(self._vocab)}
+        self._vectors = (np.asarray(vectors, np.float32)
+                         if vectors is not None else None)
+
+    @property
+    def vectors(self) -> np.ndarray:
+        return self._vectors
+
+    @property
+    def vocabulary(self) -> list[str]:
+        return list(self._vocab)
+
+    def word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self._index.get(word)
+        return None if i is None else self._vectors[i]
+
+    def find_synonyms(self, word: str, num: int = 5) -> list[tuple[str, float]]:
+        """Nearest vocabulary words by cosine similarity (Spark's
+        findSynonyms)."""
+        v = self.word_vector(word)
+        if v is None:
+            raise KeyError(f"'{word}' not in the fitted vocabulary")
+        norms = np.linalg.norm(self._vectors, axis=1) * np.linalg.norm(v)
+        sims = self._vectors @ v / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = [(self._vocab[i], float(sims[i])) for i in order
+               if self._vocab[i] != word]
+        return out[:num]
+
+    def transform(self, table: DataTable) -> DataTable:
+        self._check_required()
+        dim = self._vectors.shape[1]
+        docs = np.zeros((table.num_rows, dim), np.float32)
+        for r, toks in enumerate(table[self.inputCol]):
+            idx = [self._index[t] for t in toks if t in self._index]
+            if idx:
+                docs[r] = self._vectors[idx].mean(axis=0)
+        return table.with_column(self.outputCol, docs)
+
+    def _save_extra(self, path: str) -> None:
+        np.save(os.path.join(path, "vectors.npy"), self._vectors)
+        with open(os.path.join(path, "vocab.json"), "w") as f:
+            json.dump(self._vocab, f)
+
+    def _load_extra(self, path: str) -> None:
+        self._vectors = np.load(os.path.join(path, "vectors.npy"))
+        with open(os.path.join(path, "vocab.json")) as f:
+            self._vocab = json.load(f)
+        self._index = {w: i for i, w in enumerate(self._vocab)}
+
+
+class Word2Vec(Estimator):
+    """Fit skip-gram embeddings with negative sampling."""
+
+    inputCol = Param(None, "token-list column", ptype=str, required=True)
+    outputCol = Param("w2v", "document-vector output column", ptype=str)
+    vectorSize = Param(100, "embedding dimension", ptype=int)
+    windowSize = Param(5, "context window radius", ptype=int)
+    minCount = Param(5, "minimum token frequency to enter the vocabulary",
+                     ptype=int)
+    maxIter = Param(1, "passes over the generated pairs", ptype=int)
+    stepSize = Param(0.025, "learning rate", ptype=float)
+    numNegatives = Param(5, "negative samples per positive pair", ptype=int)
+    seed = Param(0, "rng seed", ptype=int)
+
+    def fit(self, table: DataTable) -> Word2VecModel:
+        self._check_required()
+        docs = [list(t) for t in table[self.inputCol]]
+        # vocabulary over minCount (Spark's vocab pruning)
+        flat = [t for d in docs for t in d]
+        words, counts = np.unique(np.asarray(flat, object), return_counts=True)
+        keep = counts >= self.minCount
+        vocab = [str(w) for w in words[keep]]
+        index = {w: i for i, w in enumerate(vocab)}
+        v = len(vocab)
+        dim = self.vectorSize
+        rng = np.random.default_rng(self.seed)
+        if v == 0:
+            return Word2VecModel(vocab, np.zeros((0, dim), np.float32),
+                                 inputCol=self.inputCol,
+                                 outputCol=self.outputCol)
+
+        # one vectorized pass: all (center, context) pairs in all windows
+        centers, contexts = [], []
+        win = self.windowSize
+        for d in docs:
+            ids = np.asarray([index[t] for t in d if t in index], np.int32)
+            n = len(ids)
+            for off in range(1, win + 1):
+                if n > off:
+                    centers.append(ids[:-off]); contexts.append(ids[off:])
+                    centers.append(ids[off:]);  contexts.append(ids[:-off])
+        if not centers:
+            return Word2VecModel(vocab, np.zeros((v, dim), np.float32),
+                                 inputCol=self.inputCol,
+                                 outputCol=self.outputCol)
+        centers = np.concatenate(centers)
+        contexts = np.concatenate(contexts)
+
+        # unigram^0.75 negative-sampling table
+        freq = counts[keep].astype(np.float64) ** 0.75
+        neg_p = freq / freq.sum()
+
+        in_vecs = jnp.asarray(
+            rng.uniform(-0.5 / dim, 0.5 / dim, (v, dim)).astype(np.float32))
+        out_vecs = jnp.zeros((v, dim), jnp.float32)
+        params = {"in": in_vecs, "out": out_vecs}
+        tx = optax.sgd(self.stepSize)
+        opt_state = tx.init(params)
+        k_neg = self.numNegatives
+
+        def loss_fn(p, c, o, neg):
+            vc = p["in"][c]                      # (B, D)
+            uo = p["out"][o]                     # (B, D)
+            un = p["out"][neg]                   # (B, K, D)
+            pos = jax.nn.log_sigmoid(jnp.sum(vc * uo, -1))
+            negs = jax.nn.log_sigmoid(-jnp.einsum("bd,bkd->bk", vc, un))
+            return -(pos.mean() + negs.sum(-1).mean())
+
+        @jax.jit
+        def step(p, s, c, o, neg):
+            l, g = jax.value_and_grad(loss_fn)(p, c, o, neg)
+            updates, s = tx.update(g, s, p)
+            return optax.apply_updates(p, updates), s, l
+
+        batch = 4096
+        n_pairs = len(centers)
+        pad = (-n_pairs) % batch
+        for _ in range(self.maxIter):
+            # wrap-around padding keeps every batch at the one static shape
+            # (one XLA compile); negatives are drawn per batch so host
+            # memory stays O(batch * k_neg) regardless of corpus size
+            order = np.resize(rng.permutation(n_pairs), n_pairs + pad)
+            for s0 in range(0, len(order), batch):
+                sl = order[s0:s0 + batch]
+                negs = rng.choice(v, size=(batch, k_neg),
+                                  p=neg_p).astype(np.int32)
+                params, opt_state, _ = step(
+                    params, opt_state,
+                    jnp.asarray(centers[sl]), jnp.asarray(contexts[sl]),
+                    jnp.asarray(negs))
+        return Word2VecModel(vocab, np.asarray(params["in"]),
+                             inputCol=self.inputCol,
+                             outputCol=self.outputCol)

@@ -293,14 +293,23 @@ class LinearRegression(Estimator):
 
 
 def _solve_ridge(X, y, reg, fit_intercept):
+    # least-squares on X itself (not the normal equations): squaring the
+    # condition number in float32 destroys the solve whenever featurization
+    # emits collinear blocks (e.g. a one-hot family summing to the
+    # intercept); lstsq's min-norm solution stays stable.  Ridge becomes
+    # sqrt(lambda) augmentation rows, keeping one code path.
     if fit_intercept:
         mu_x, mu_y = X.mean(0), y.mean()
         Xc, yc = X - mu_x, y - mu_y
     else:
         Xc, yc = X, y
     d = X.shape[1]
-    gram = Xc.T @ Xc + (reg * len(y) + 1e-6) * jnp.eye(d, dtype=X.dtype)
-    w = jnp.linalg.solve(gram, Xc.T @ yc)
+    lam = reg * len(y)
+    if lam > 0:
+        Xc = jnp.concatenate(
+            [Xc, jnp.sqrt(lam) * jnp.eye(d, dtype=X.dtype)])
+        yc = jnp.concatenate([yc, jnp.zeros((d,), y.dtype)])
+    w = jnp.linalg.lstsq(Xc, yc)[0]
     b = (mu_y - mu_x @ w) if fit_intercept else jnp.zeros(())
     return w, b
 

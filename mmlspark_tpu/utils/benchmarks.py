@@ -1,0 +1,75 @@
+"""The learner-grid metric benchmark.
+
+Counterpart of the reference's committed metric regression net
+(VerifyTrainClassifier.scala:36-37,203-216 + benchmarkMetrics.csv): every
+learner family trained on every grid dataset, metrics rounded and diffed
+EXACTLY against a committed CSV.  Regeneration is deliberate:
+
+    python scripts/regen_benchmarks.py
+
+after any change that legitimately moves the numbers; the test fails on any
+unintentional drift.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+
+def _learners():
+    from mmlspark_tpu.ml import (DecisionTreeClassifier, GBTClassifier,
+                                 LogisticRegression,
+                                 MultilayerPerceptronClassifier, NaiveBayes,
+                                 RandomForestClassifier)
+    return {
+        "LogisticRegression": lambda: LogisticRegression(),
+        "DecisionTreeClassifier": lambda: DecisionTreeClassifier(maxDepth=5),
+        "RandomForestClassifier": lambda: RandomForestClassifier(
+            numTrees=10, maxDepth=5),
+        "GBTClassifier": lambda: GBTClassifier(maxIter=10, maxDepth=4),
+        "NaiveBayes": lambda: NaiveBayes(),
+        "MultilayerPerceptronClassifier":
+            lambda: MultilayerPerceptronClassifier(layers=[-1, 16, -1],
+                                                   maxIter=30, seed=0),
+    }
+
+
+def compute_learner_grid() -> list[dict]:
+    """accuracy (+AUC when binary) for every (dataset, learner) pair."""
+    from mmlspark_tpu.ml import ComputeModelStatistics, TrainClassifier
+    from mmlspark_tpu.utils.demo_data import grid_datasets
+
+    rows = []
+    for ds_name, table in grid_datasets().items():
+        label = "income" if "income" in table.columns else "label"
+        n_train = int(table.num_rows * 0.75)
+        train = table.slice(0, n_train)
+        test = table.slice(n_train, table.num_rows)
+        for learner_name, make in _learners().items():
+            # NB needs non-negative features; skip it off the raw-numeric
+            # datasets with negative values (the reference grid also runs
+            # each learner only where it applies)
+            if learner_name == "NaiveBayes" and ds_name != "census_mixed":
+                continue
+            # binary-only, as in the reference (TrainClassifier.scala:101-104)
+            if learner_name == "GBTClassifier" and ds_name == "blobs_3class":
+                continue
+            model = TrainClassifier(make(), labelCol=label).fit(train)
+            metrics = ComputeModelStatistics().transform(
+                model.transform(test))
+            row = {"dataset": ds_name, "learner": learner_name,
+                   "accuracy": round(float(metrics["accuracy"][0]), 6)}
+            row["AUC"] = (round(float(metrics["AUC"][0]), 6)
+                          if "AUC" in metrics.columns else "")
+            rows.append(row)
+    return rows
+
+
+def grid_to_csv(rows: list[dict]) -> str:
+    buf = io.StringIO()
+    buf.write("dataset,learner,accuracy,AUC\n")
+    for r in rows:
+        buf.write(f"{r['dataset']},{r['learner']},{r['accuracy']},{r['AUC']}\n")
+    return buf.getvalue()

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Deliberately regenerate the committed learner-grid metric CSV.
+
+Counterpart of regenerating the reference's benchmarkMetrics.csv
+(VerifyTrainClassifier.scala:203-216).  Run after a change that
+legitimately moves the numbers, review the diff, and commit it.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mmlspark_tpu.utils.benchmarks import compute_learner_grid, grid_to_csv
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "tests",
+                   "benchmark_metrics.csv")
+
+csv = grid_to_csv(compute_learner_grid())
+with open(OUT, "w") as f:
+    f.write(csv)
+print(csv)
+print(f"wrote {os.path.normpath(OUT)}")

@@ -25,6 +25,12 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # tag-gated tests, the reference's Extended/LinuxOnly analogue
+    # (TestBase.scala:16-24, tools/config.sh:119-141)
+    config.addinivalue_line("markers", "slow: long-running (build/e2e) test")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

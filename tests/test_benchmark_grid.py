@@ -1,0 +1,48 @@
+"""Committed metric-regression grid.
+
+Counterpart of the reference's benchmarkMetrics.csv exact-diff
+(VerifyTrainClassifier.scala:36-37,203-216): every learner family on every
+grid dataset must reproduce the committed metrics EXACTLY.  Legitimate
+changes regenerate deliberately via scripts/regen_benchmarks.py.
+"""
+
+import os
+
+import pytest
+
+from mmlspark_tpu.utils.benchmarks import compute_learner_grid, grid_to_csv
+
+CSV = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "benchmark_metrics.csv")
+
+
+@pytest.mark.slow
+def test_learner_grid_matches_committed_csv():
+    with open(CSV) as f:
+        committed = f.read()
+    computed = grid_to_csv(compute_learner_grid())
+    if computed != committed:
+        com_lines = committed.splitlines()
+        new_lines = computed.splitlines()
+        drift = [f"  {a!r} -> {b!r}" for a, b in zip(com_lines, new_lines)
+                 if a != b]
+        drift += [f"  only committed: {l!r}" for l in
+                  com_lines[len(new_lines):]]
+        drift += [f"  only computed: {l!r}" for l in
+                  new_lines[len(com_lines):]]
+        raise AssertionError(
+            "learner-grid metrics drifted from tests/benchmark_metrics.csv "
+            "(regenerate DELIBERATELY with scripts/regen_benchmarks.py if "
+            "the change is intended):\n" + "\n".join(drift))
+
+
+def test_grid_covers_every_learner_family():
+    with open(CSV) as f:
+        lines = f.read().splitlines()[1:]
+    learners = {l.split(",")[1] for l in lines}
+    assert learners == {
+        "LogisticRegression", "DecisionTreeClassifier",
+        "RandomForestClassifier", "GBTClassifier", "NaiveBayes",
+        "MultilayerPerceptronClassifier"}
+    datasets = {l.split(",")[0] for l in lines}
+    assert len(datasets) == 5

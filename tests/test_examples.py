@@ -1,0 +1,74 @@
+"""Execute every example workload end-to-end (the reference's notebook
+test harness, tools/notebook/tester/NotebookTestSuite.py:8-56: each sample
+notebook runs under the test suite; here each example module's main() runs
+in-process with thresholds asserted)."""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+EXAMPLES_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+def _run(name: str) -> dict:
+    path = os.path.join(EXAMPLES_DIR, name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(verbose=False)
+
+
+@pytest.mark.slow
+def test_example_101_adult_census():
+    out = _run("example_101_adult_census.py")
+    assert len(out["accuracies"]) == 6          # all learner families
+    assert max(out["accuracies"].values()) > 0.75
+    assert out["best_metrics"]["accuracy"] == max(out["accuracies"].values())
+    assert out["confusion_matrix"].shape == (2, 2)
+
+
+@pytest.mark.slow
+def test_example_102_flight_delays():
+    out = _run("example_102_flight_delays.py")
+    assert set(out["metrics"]) == {"LinearRegression", "RandomForest", "GBT"}
+    for name, m in out["metrics"].items():
+        assert m["R^2"] > 0.5, (name, m)
+
+
+@pytest.mark.slow
+def test_example_103_before_and_after():
+    out = _run("example_103_before_and_after.py")
+    assert out["manual_accuracy"] > 0.7
+    assert out["auto_accuracy"] > 0.7
+
+
+@pytest.mark.slow
+def test_example_201_text_featurizer():
+    out = _run("example_201_text_featurizer.py")
+    assert out["accuracy"] > 0.9 and out["AUC"] > 0.9
+
+
+@pytest.mark.slow
+def test_example_202_word2vec():
+    out = _run("example_202_word2vec.py")
+    assert out["accuracy"] > 0.85
+    assert out["n_vocab"] > 20
+
+
+@pytest.mark.slow
+def test_example_301_cifar_eval(tmp_path):
+    out = _run("example_301_cifar_eval.py")
+    assert out["accuracy"] > 0.8       # synthetic classes are learnable
+    assert out["confusion_matrix"].shape == (10, 10)
+
+
+@pytest.mark.slow
+def test_example_302_image_pipeline():
+    out = _run("example_302_image_pipeline.py")
+    assert out["n_images"] == 96
+    assert out["feature_dim"] == 512
+    assert out["accuracy"] > 0.8

@@ -94,6 +94,45 @@ def test_idf_downweights_common_terms():
     assert all(dense[:, s].max() > 0 for s in rare_slots)
 
 
+def test_word2vec_learns_cooccurrence(tmp_path):
+    """Words sharing contexts embed closer than words that never co-occur;
+    documents transform to mean vectors; model round-trips."""
+    from mmlspark_tpu.core.table import object_column
+    from mmlspark_tpu.feature import Word2Vec
+
+    rng = np.random.default_rng(0)
+    docs = []
+    for _ in range(300):
+        if rng.integers(0, 2):
+            docs.append(list(rng.permutation(
+                ["hot", "warm", "sun", "summer"])))
+        else:
+            docs.append(list(rng.permutation(
+                ["cold", "ice", "winter", "snow"])))
+    t = DataTable({"tokens": object_column(docs)})
+    model = Word2Vec(inputCol="tokens", outputCol="v", vectorSize=16,
+                     windowSize=3, minCount=1, maxIter=10, seed=0).fit(t)
+
+    def sim(a, b):
+        va, vb = model.word_vector(a), model.word_vector(b)
+        return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb)))
+
+    assert sim("hot", "warm") > sim("hot", "cold")
+    assert sim("ice", "snow") > sim("ice", "sun")
+
+    out = model.transform(t)
+    assert out["v"].shape == (300, 16)
+    np.testing.assert_allclose(
+        out["v"][0],
+        np.mean([model.word_vector(w) for w in docs[0]], axis=0),
+        rtol=1e-5)
+
+    model.save(str(tmp_path / "w2v"))
+    loaded = load_stage(str(tmp_path / "w2v"))
+    assert loaded.vocabulary == model.vocabulary
+    np.testing.assert_array_equal(loaded.vectors, model.vectors)
+
+
 def test_text_featurizer_end_to_end(tmp_path):
     t = DataTable({"txt": ["The quick brown fox", "the lazy dog",
                            "quick quick dog"]})

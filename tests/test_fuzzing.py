@@ -56,7 +56,7 @@ def _fixtures():
     from mmlspark_tpu import Pipeline
     from mmlspark_tpu.feature import (AssembleFeatures, Featurize, HashingTF,
                                       IDF, NGram, StopWordsRemover,
-                                      TextFeaturizer, Tokenizer)
+                                      TextFeaturizer, Tokenizer, Word2Vec)
     from mmlspark_tpu.ml import (ComputeModelStatistics,
                                  ComputePerInstanceStatistics,
                                  DecisionTreeClassifier,
@@ -109,6 +109,9 @@ def _fixtures():
                       numFeatures=64).transform(txt)),
         "TextFeaturizer": lambda: (
             TextFeaturizer(inputCol="txt", numFeatures=64), txt),
+        "Word2Vec": lambda: (
+            Word2Vec(inputCol="tokens", vectorSize=4, minCount=1,
+                     maxIter=1), txt),
         "AssembleFeatures": lambda: (
             AssembleFeatures(columnsToFeaturize=["double_0", "int_1"],
                              numberOfFeatures=64), gen),
@@ -171,7 +174,7 @@ _MODEL_ONLY = {
     "NaiveBayesModel", "MultilayerPerceptronClassifierModel",
     "OneVsRestModel", "TrainedClassifierModel", "TrainedRegressorModel",
     "BestModel", "ClassifierModel", "RegressorModel", "Evaluator",
-    "TreeClassifierModel", "TreeRegressorModel",
+    "TreeClassifierModel", "TreeRegressorModel", "Word2VecModel",
 }
 
 

@@ -155,7 +155,11 @@ class TPUModel(Transformer):
         # Pipelined dispatch: enqueue transfer+compute for a window of
         # batches before fetching, so host->device transfers overlap with
         # device compute (the reference's JNI loop was fully synchronous
-        # per batch, CNTKModel.scala:63-92).
+        # per batch, CNTKModel.scala:63-92).  Each output's device->host
+        # copy is started asynchronously the moment its compute is enqueued:
+        # over a high-latency link (tunneled chips) serialized blocking
+        # fetches cost a full round-trip each, while concurrent async
+        # copies overlap with later transfers and compute.
         window = 8
         n = len(col)
         in_flight: list[tuple[Any, int]] = []
@@ -164,7 +168,7 @@ class TPUModel(Transformer):
         def drain(count: int):
             while len(in_flight) > count:
                 out, valid = in_flight.pop(0)
-                results.append(np.asarray(jax.device_get(out))[:valid])
+                results.append(np.asarray(out)[:valid])
 
         for start in range(0, n, bs):
             if dev_col is not None:
@@ -177,7 +181,12 @@ class TPUModel(Transformer):
             else:
                 chunk, valid = pad_to_multiple(col[start:start + bs], bs)
                 dev = jax.device_put(chunk, sharding)
-            in_flight.append((apply_fn(variables, dev), valid))
+            out = apply_fn(variables, dev)
+            try:
+                out.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # committed-to-host backends need no prefetch
+            in_flight.append((out, valid))
             drain(window)
         drain(0)
         if results:

@@ -7,11 +7,16 @@ SparkSessionFactory.scala:40-51 — all "distributed" tests single-host).
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# MMLSPARK_TPU_TEST_PLATFORM=tpu runs the suite against the real chip
+# (scripts/check.sh uses it for the TPU-gated perf floors); default is the
+# 8-virtual-device CPU mesh.
+_platform = os.environ.get("MMLSPARK_TPU_TEST_PLATFORM", "cpu")
+if _platform == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 # The environment's sitecustomize may import jax at interpreter startup
@@ -19,7 +24,8 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # jax.config can still flip the platform before any backend initializes.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -199,11 +199,12 @@ def main():
                         help="tiny sizes for CI schema checks")
     args = parser.parse_args()
 
-    link = probe_link_mbps()
-    resnet = bench_resnet50(args.smoke)
-    print(json.dumps({**resnet, **link}))
+    # probe adjacent to each measurement — tunnel bandwidth swings over
+    # minutes, and a stale probe would misattribute exactly the way the
+    # probe exists to prevent
+    print(json.dumps({**bench_resnet50(args.smoke), **probe_link_mbps()}))
     headline = bench_convnet(args.smoke)
-    print(json.dumps({**headline, **link}), flush=True)
+    print(json.dumps({**headline, **probe_link_mbps()}), flush=True)
 
 
 if __name__ == "__main__":

@@ -14,6 +14,7 @@ import time
 
 import numpy as np
 
+from mmlspark_tpu import stage_timing
 from mmlspark_tpu.core.schema import SchemaConstants, set_score_column
 from mmlspark_tpu.ml import ComputeModelStatistics
 from mmlspark_tpu.models import TPUModel
@@ -23,6 +24,15 @@ from mmlspark_tpu.zoo import ModelDownloader, create_builtin_repo
 
 
 def main(verbose: bool = True, out_dir: str = "/tmp/mmlspark_tpu_zoo") -> dict:
+    with stage_timing() as times:
+        result = _run(verbose, out_dir)
+    if verbose:
+        print("\nstage times:\n" + times.table())
+    result["stage_times"] = times.records
+    return result
+
+
+def _run(verbose: bool, out_dir: str) -> dict:
     log = print if verbose else (lambda *a, **k: None)
     data = cifar_like(n=512, seed=3)
     n_train = 384

@@ -34,3 +34,5 @@ from mmlspark_tpu.core.pipeline import (
     load_stage,
 )
 from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.observe import (MetricData, get_logger, profile,
+                                  stage_timing)

@@ -40,6 +40,20 @@ class PipelineStage(Params):
         super().__init__(**kwargs)
         self.uid = _fresh_uid(type(self).__name__)
 
+    def __init_subclass__(cls, **kwargs):
+        # Every stage's fit/transform is wrapped for the opt-in stage timer
+        # (observe/timing.py) — one contextvar check when inactive.  Wrapping
+        # happens at class creation so stages defined outside the framework
+        # are covered too.
+        super().__init_subclass__(**kwargs)
+        from mmlspark_tpu.observe.timing import instrument_stage_method
+        for method in ("fit", "transform"):
+            fn = cls.__dict__.get(method)
+            if fn is not None and not getattr(
+                    fn, "__mmlspark_instrumented__", False):
+                setattr(cls, method,
+                        instrument_stage_method(cls.__name__, method, fn))
+
     # -- persistence ----------------------------------------------------
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)

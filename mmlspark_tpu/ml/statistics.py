@@ -167,6 +167,26 @@ class EvalResult:
             raise ValueError("no binary ROC computed")
         return roc_table(self.roc)
 
+    def to_metric_data(self, metric_type: str = "evaluation",
+                       model_name: str = "model"):
+        """The typed logging contract (reference Metrics.scala:37-47; the
+        scala logs scalar metrics AND the full ROC table through it,
+        ComputeModelStatistics.scala:486-521)."""
+        from mmlspark_tpu.observe import MetricData
+        return MetricData.create(
+            {k: float(self.metrics[k][0]) for k in self.metrics.columns},
+            metric_type, model_name)
+
+    def roc_metric_data(self, model_name: str = "model"):
+        if self.roc is None:
+            raise ValueError("no binary ROC computed")
+        fpr, tpr, thr = self.roc
+        from mmlspark_tpu.observe import MetricData
+        return MetricData.create_table(
+            {"false_positive_rate": list(fpr), "true_positive_rate": list(tpr),
+             "threshold": list(np.clip(thr, -1e300, 1e300))},
+            "roc", model_name)
+
 
 def roc_table(roc: tuple) -> DataTable:
     fpr, tpr, thr = roc
@@ -194,9 +214,14 @@ class ComputeModelStatistics(Evaluator):
             table, self.labelCol)
         metric = self.evaluationMetric
         if kind == SchemaConstants.REGRESSION_KIND:
-            return self._regression(table, label, scores, metric)
-        return self._classification(table, label, scores, scored_labels,
-                                    probs, metric)
+            result = self._regression(table, label, scores, metric)
+        else:
+            result = self._classification(table, label, scores,
+                                          scored_labels, probs, metric)
+        # every evaluation flows through the typed metric contract
+        # (reference ComputeModelStatistics.scala:486-521 -> MetricData)
+        result.to_metric_data(metric_type=kind).log("ml.statistics", "debug")
+        return result
 
     def transform(self, table: DataTable) -> DataTable:
         return self.evaluate(table).metrics

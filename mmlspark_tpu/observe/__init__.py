@@ -1,0 +1,12 @@
+"""Observability: logger factory, typed metric contract, stage timers, and
+device profiling (reference Logging.scala:14-23 + Metrics.scala:37-47 +
+TestBase.scala:138-153; the profiler is TPU-native headroom)."""
+
+from mmlspark_tpu.observe.logging import LOG_ROOT, get_logger
+from mmlspark_tpu.observe.metrics import MetricData
+from mmlspark_tpu.observe.profiler import annotate, profile
+from mmlspark_tpu.observe.timing import (StageTimings, instrument_stage_method,
+                                         stage_timing)
+
+__all__ = ["LOG_ROOT", "get_logger", "MetricData", "annotate", "profile",
+           "StageTimings", "instrument_stage_method", "stage_timing"]

@@ -1,0 +1,47 @@
+"""Namespaced logger factory (reference Logging.scala:14-23).
+
+The reference constructs every logger as `<configured root>.<suffix>` through
+one factory so the whole framework is silenceable/redirectable from a single
+knob.  Same here: `get_logger("ml.statistics")` -> logger
+"mmlspark_tpu.ml.statistics", with the root level driven by
+MMLSPARK_TPU_LOG_LEVEL (registered in mmlspark_tpu.config).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+LOG_ROOT = "mmlspark_tpu"
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    root = logging.getLogger(LOG_ROOT)
+    level = os.environ.get("MMLSPARK_TPU_LOG_LEVEL")
+    if level is not None:
+        # the user asked the framework to manage its own output: set the
+        # level and attach a handler so records print without propagating
+        # twice through an application root
+        root.setLevel(getattr(logging, level.upper(), logging.WARNING))
+        if not any(isinstance(h, logging.StreamHandler)
+                   for h in root.handlers):
+            handler = logging.StreamHandler()
+            handler.setFormatter(logging.Formatter(
+                "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+            root.addHandler(handler)
+            root.propagate = False
+    # otherwise: normal library behavior — no handlers, propagation on,
+    # the application's logging config decides what shows
+    _configured = True
+
+
+def get_logger(suffix: str = "") -> logging.Logger:
+    """The canonical logger for a subsystem: one per package, named under
+    the framework root (`get_logger("train")` -> "mmlspark_tpu.train")."""
+    _configure_root()
+    name = f"{LOG_ROOT}.{suffix}" if suffix else LOG_ROOT
+    return logging.getLogger(name)

@@ -1,0 +1,44 @@
+"""Opt-in device profiling (what the reference never had — its JNI scoring
+loop was unobservable; diagnosing round 2's throughput swing took manual
+probing).
+
+    with mmlspark_tpu.profile("/tmp/trace"):
+        model.transform(table)
+
+wraps jax.profiler.trace: the dump is a TensorBoard/Perfetto trace showing
+host transfer vs MXU occupancy per step.  `annotate(name)` adds a named span
+inside an active trace (jax.profiler.TraceAnnotation) around host-side code
+so framework phases (batching, padding, fetch) are visible between device
+ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def profile(log_dir: str, *, host_tracer_level: int = 2):
+    """Capture a device+host trace of the block into `log_dir`."""
+    # Probe jax's trace() signature BEFORE entering the block: a TypeError
+    # raised by user code inside the block must propagate untouched, never
+    # be mistaken for an old-jax signature mismatch.
+    kwargs: dict = {}
+    try:
+        import inspect
+        if "profiler_options" in inspect.signature(
+                jax.profiler.trace).parameters:
+            options = jax.profiler.ProfileOptions()
+            options.host_tracer_level = host_tracer_level
+            kwargs["profiler_options"] = options
+    except Exception:
+        pass  # older jax: no options support
+    with jax.profiler.trace(log_dir, **kwargs):
+        yield log_dir
+
+
+def annotate(name: str):
+    """Named host-side span, visible inside an active trace."""
+    return jax.profiler.TraceAnnotation(name)

@@ -84,5 +84,5 @@ class TPULearner(Estimator):
 
 
 def _log(msg: str) -> None:
-    import logging
-    logging.getLogger("mmlspark_tpu.train").info(msg)
+    from mmlspark_tpu.observe import get_logger
+    get_logger("train").info(msg)

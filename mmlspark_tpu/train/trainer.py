@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mmlspark_tpu.models.bundle import ModelBundle, _to_plain
 from mmlspark_tpu.models.definitions import build_model
+from mmlspark_tpu.observe import MetricData, get_logger
 from mmlspark_tpu.parallel.bridge import (gather_replicated, gather_to_host,
                                           put_sharded)
 from mmlspark_tpu.parallel.distributed import initialize_distributed, is_coordinator
@@ -280,13 +281,23 @@ class Trainer:
             rec = {"epoch": epoch, "loss": epoch_loss / max(n_batches, 1),
                    "wall_s": time.perf_counter() - t0}
             self.history.append(rec)
-            if log_fn and (epoch % max(1, log_every) == 0 or
-                           epoch == cfg.epochs - 1):
-                log_fn(f"epoch {epoch}: loss={rec['loss']:.5f} "
-                       f"({rec['wall_s']:.1f}s)")
+            emit = log_fn if log_fn is not None else get_logger("train").info
+            if epoch % max(1, log_every) == 0 or epoch == cfg.epochs - 1:
+                emit(f"epoch {epoch}: loss={rec['loss']:.5f} "
+                     f"({rec['wall_s']:.1f}s)")
         if cfg.checkpoint_dir:
             self.save_checkpoint(state, cfg.checkpoint_dir)
+        # the run's loss curve through the typed contract (Metrics.scala:37-47)
+        self.training_metric_data().log("train", "debug")
         return self.bundle_from_state(state)
+
+    def training_metric_data(self) -> MetricData:
+        """This trainer's history as a typed metric table."""
+        return MetricData.create_table(
+            {"epoch": [r["epoch"] for r in self.history],
+             "loss": [r["loss"] for r in self.history],
+             "wall_s": [r["wall_s"] for r in self.history]},
+            "training", self.config.architecture)
 
     def bundle_from_state(self, state: TrainState) -> ModelBundle:
         # collective under multi-host (gathers TP-sharded leaves); every

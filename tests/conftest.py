@@ -37,6 +37,26 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running (build/e2e) test")
 
 
+# -- test-duration alert budgets (reference TestBase.scala:47-68,138-153:
+# alert at >3s/test, >10s/suite; XLA compiles make those numbers 10x here,
+# MMLSPARK_TPU_TEST_BUDGET_S overrides) -------------------------------------
+_TEST_BUDGET_S = float(os.environ.get("MMLSPARK_TPU_TEST_BUDGET_S", "30"))
+_over_budget: list = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call" and report.duration > _TEST_BUDGET_S:
+        _over_budget.append((report.nodeid, report.duration))
+
+
+def pytest_terminal_summary(terminalreporter):
+    if _over_budget:
+        terminalreporter.section(
+            f"tests over the {_TEST_BUDGET_S:.0f}s alert budget")
+        for nodeid, duration in sorted(_over_budget, key=lambda t: -t[1]):
+            terminalreporter.write_line(f"  ALERT {duration:7.1f}s  {nodeid}")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

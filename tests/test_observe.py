@@ -1,0 +1,142 @@
+"""Observability layer: logger factory, MetricData contract, stage timers,
+profiler context (reference Logging.scala:14-23, Metrics.scala:37-47,
+TestBase.scala:138-153)."""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataTable, MetricData, get_logger, stage_timing
+from mmlspark_tpu.observe import profile
+
+
+def test_logger_factory_namespacing():
+    assert get_logger().name == "mmlspark_tpu"
+    assert get_logger("ml.statistics").name == "mmlspark_tpu.ml.statistics"
+    # one root config: the suffixed logger inherits through the framework root
+    assert (get_logger("anything").getEffectiveLevel()
+            == get_logger().getEffectiveLevel())
+
+
+def test_metric_data_scalar_and_table():
+    md = MetricData.create({"accuracy": 0.9, "AUC": 0.95},
+                           "classification", "lr")
+    assert md.num_rows == 1
+    assert md.scalars() == {"accuracy": 0.9, "AUC": 0.95}
+    assert "classification" in str(md) and "lr" in str(md)
+
+    table = MetricData.create_table(
+        {"loss": [1.0, 0.5, 0.25], "epoch": [0, 1, 2]}, "training", "mlp")
+    assert table.num_rows == 3
+    with pytest.raises(ValueError):
+        table.scalars()
+    dt = table.to_table()
+    assert dt.columns == ["loss", "epoch"]
+    assert np.allclose(dt["loss"], [1.0, 0.5, 0.25])
+
+
+def test_metric_data_rejects_ragged_columns():
+    with pytest.raises(ValueError):
+        MetricData({"a": [1.0], "b": [1.0, 2.0]}, "t", "m")
+
+
+def test_metric_data_log_routes_through_factory(caplog):
+    md = MetricData.create({"mse": 0.1}, "regression", "linreg")
+    with caplog.at_level(logging.INFO, logger="mmlspark_tpu.ml"):
+        md.log("ml", "info")
+    assert any("linreg" in r.message and "mse" in r.message
+               for r in caplog.records)
+
+
+def test_stage_timing_tree():
+    from mmlspark_tpu import Pipeline
+    from mmlspark_tpu.ml import TrainClassifier
+    from mmlspark_tpu.ml.learners import LogisticRegression
+    from mmlspark_tpu.stages.basic import SelectColumns
+
+    rng = np.random.default_rng(0)
+    table = DataTable({
+        "f0": rng.standard_normal(64).astype(np.float32),
+        "f1": rng.standard_normal(64).astype(np.float32),
+        "label": (rng.random(64) > 0.5).astype(np.int32),
+        "junk": rng.standard_normal(64).astype(np.float32),
+    })
+    pipe = Pipeline([
+        SelectColumns(cols=["f0", "f1", "label"]),
+        TrainClassifier(model=LogisticRegression(), labelCol="label"),
+    ])
+    with stage_timing() as times:
+        model = pipe.fit(table)
+        model.transform(table)
+    stages = [(r["depth"], r["stage"], r["method"]) for r in times.records]
+    assert (0, "Pipeline", "fit") in stages
+    # nested stages recorded one level deeper
+    assert any(d == 1 and s == "TrainClassifier" for d, s, _ in stages)
+    assert all(r["seconds"] >= 0 for r in times.records)
+    # total() counts only top-level records (no double counting)
+    assert times.total() <= sum(r["seconds"] for r in times.records) + 1e-9
+    text = times.table()
+    assert "Pipeline.fit" in text and "seconds" in text
+
+
+def test_stage_timing_inactive_is_silent():
+    from mmlspark_tpu.stages.basic import SelectColumns
+    t = DataTable({"a": np.arange(4.0)})
+    out = SelectColumns(cols=["a"]).transform(t)  # no collector active
+    assert out.columns == ["a"]
+
+
+def test_eval_result_metric_data():
+    from mmlspark_tpu.core.schema import SchemaConstants, set_score_column
+    from mmlspark_tpu.ml import ComputeModelStatistics
+
+    rng = np.random.default_rng(0)
+    y = (rng.random(200) > 0.5).astype(np.float64)
+    pred = np.where(rng.random(200) < 0.8, y, 1 - y)
+    t = DataTable({"label": y, "prediction": pred,
+                   "prob": np.clip(pred + rng.normal(0, .1, 200), 0, 1)})
+    set_score_column(t, "m", "prediction", SchemaConstants.SCORED_LABELS_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    set_score_column(t, "m", "label", SchemaConstants.TRUE_LABELS_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    set_score_column(t, "m", "prob", SchemaConstants.SCORED_PROBABILITIES_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    res = ComputeModelStatistics().evaluate(t)
+    md = res.to_metric_data("classification", "demo")
+    assert 0.5 < md.scalars()["accuracy"] <= 1.0
+    roc_md = res.roc_metric_data("demo")
+    assert roc_md.metric_type == "roc"
+    assert roc_md.num_rows == len(res.roc[0])
+
+
+def test_trainer_training_metric_data():
+    from mmlspark_tpu.train import TrainerConfig
+    from mmlspark_tpu.train.trainer import Trainer
+
+    cfg = TrainerConfig(architecture="LinearModel",
+                        model_config={"num_outputs": 1},
+                        optimizer="sgd", learning_rate=0.1, epochs=3,
+                        batch_size=16, loss="mse", seed=0)
+    tr = Trainer(cfg)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    yv = (x @ np.asarray([1., -2., 0.5, 0.], np.float32))[:, None]
+    tr.fit_arrays(x, yv.astype(np.float32))
+    md = tr.training_metric_data()
+    assert md.metric_type == "training"
+    assert md.model_name == "LinearModel"
+    assert md.num_rows == 3
+    assert md.data["loss"][0] >= md.data["loss"][-1] * 0.5  # it trained
+
+
+def test_profile_writes_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    d = str(tmp_path / "trace")
+    with profile(d):
+        jax.jit(lambda x: x * 2)(jnp.ones((8,))).block_until_ready()
+    # jax writes plugins/profile/<ts>/*.pb under the log dir
+    found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+    assert found, "profiler produced no trace files"

@@ -74,6 +74,13 @@ def test_bench_contract_fields():
         assert device_peak_flops() is None
         assert mfu(1000.0, 1e9) is None
     assert mfu(1000.0, None) is None
+    # the actual emitted schema, exercised (smoke sizes run on any backend)
+    result = bench.bench_convnet(smoke=True)
+    assert {"metric", "value", "unit", "vs_baseline", "mfu",
+            "device_images_per_sec", "device_mfu"} <= set(result)
+    assert result["value"] > 0 and result["device_images_per_sec"] > 0
+    link = bench.probe_link_mbps()
+    assert {"link_h2d_MBps", "link_d2h_MBps"} <= set(link)
 
 
 @pytest.mark.skipif(not on_tpu, reason="MFU floor needs a real TPU chip")

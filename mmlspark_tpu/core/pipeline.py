@@ -52,7 +52,7 @@ class PipelineStage(Params):
             if fn is not None and not getattr(
                     fn, "__mmlspark_instrumented__", False):
                 setattr(cls, method,
-                        instrument_stage_method(cls.__name__, method, fn))
+                        instrument_stage_method(method, fn))
 
     # -- persistence ----------------------------------------------------
     def save(self, path: str) -> None:

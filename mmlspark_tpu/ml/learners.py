@@ -230,8 +230,10 @@ class OneVsRest(Estimator):
             raise ParamError("OneVsRest: no base classifier set")
         y = np.asarray(table[self.labelCol], np.int64)
         n_classes = int(y.max()) + 1 if len(y) else 0
-        if isinstance(self._classifier, LogisticRegression):
-            # fast path: one vmapped fit over all classes
+        if type(self._classifier) is LogisticRegression:
+            # fast path: one vmapped fit over all classes.  Exact-type gate:
+            # a subclass with overridden fit() must take the generic path,
+            # not be silently fitted with base-class math
             base = self._classifier
             X = _features_matrix(table[self.featuresCol])
             Y = (y[None, :] == np.arange(n_classes)[:, None]).astype(np.float32)

@@ -150,6 +150,10 @@ class TPUModel(Transformer):
         bs = self.miniBatchSize
         n_data = mesh.shape["data"]
         bs = max(bs, n_data) - (max(bs, n_data) % n_data) or n_data
+        if jax.process_count() > 1:
+            result = self._transform_multihost(col, mesh, variables,
+                                               apply_fn, bs)
+            return table.with_column(self.outputCol, result)
         sharding = batch_sharding(mesh)
 
         # Pipelined dispatch: enqueue transfer+compute for a window of
@@ -200,6 +204,67 @@ class TPUModel(Transformer):
                 jax.ShapeDtypeStruct((bs,) + col.shape[1:], col.dtype))
             result = np.zeros((0,) + out_shape.shape[1:], out_shape.dtype)
         return table.with_column(self.outputCol, result)
+
+    def _transform_multihost(self, col, mesh, variables, apply_fn,
+                             bs: int) -> np.ndarray:
+        """Scoring under process_count > 1: each process feeds its LOCAL
+        table partition (the same per-process data convention as
+        Trainer.fit_arrays) and gets back scores for exactly its own rows.
+
+        The reference's only *required* distributed behavior is this one —
+        CNTKModel scoring partitions on every executor
+        (CNTKModel.scala:215-221).  Here every process contributes
+        bs/process_count rows per step via `put_sharded` (no host ever
+        holds the global batch), all processes run the same number of
+        jitted steps (collectives in lockstep — processes with fewer rows
+        feed padding), and each extracts its addressable output rows with
+        `global_array_to_host_local_array`.
+        """
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+
+        from mmlspark_tpu.parallel.bridge import put_sharded
+        from mmlspark_tpu.parallel.mesh import DATA_AXIS
+
+        nproc = jax.process_count()
+        n_data = mesh.shape[DATA_AXIS]
+        if n_data % nproc:
+            raise ValueError(
+                f"multi-host scoring needs the data axis ({n_data}) to be "
+                f"a multiple of the process count ({nproc})")
+        bs_local = bs // nproc
+        n_local = len(col)
+        # every process must run the same step count or collectives deadlock
+        n_steps = int(np.ceil(multihost_utils.process_allgather(
+            np.asarray(n_local)).max() / bs_local)) or 1
+        sharding = batch_sharding(mesh)
+        out_spec = P(DATA_AXIS)
+        window = 8
+        in_flight: list[tuple[Any, int]] = []
+        results: list[np.ndarray] = []
+
+        def drain(count: int):
+            while len(in_flight) > count:
+                out, valid = in_flight.pop(0)
+                local = multihost_utils.global_array_to_host_local_array(
+                    out, mesh, out_spec)
+                results.append(np.asarray(local)[:valid])
+
+        feed_shape = (bs_local,) + col.shape[1:]
+        for step in range(n_steps):
+            chunk = col[step * bs_local:(step + 1) * bs_local]
+            valid = int(chunk.shape[0])
+            if valid < bs_local:
+                feed = np.zeros(feed_shape, col.dtype)
+                feed[:valid] = chunk
+                chunk = feed
+            dev = put_sharded(np.ascontiguousarray(chunk), sharding)
+            in_flight.append((apply_fn(variables, dev), valid))
+            drain(window)
+        drain(0)
+        # n_steps >= 1 always, so results is never empty (a zero-row local
+        # partition still yields one [:0]-trimmed batch of the right rank)
+        return np.concatenate(results, axis=0)
 
     # -- persistence ----------------------------------------------------
     def _save_extra(self, path: str) -> None:

@@ -70,7 +70,7 @@ def stage_timing():
         _collector.reset(token)
 
 
-def instrument_stage_method(cls_name: str, method_name: str, fn):
+def instrument_stage_method(method_name: str, fn):
     """Wrap a fit/transform definition; called from PipelineStage's
     __init_subclass__ so every stage in and out of the framework is covered
     without per-stage code."""
@@ -80,7 +80,9 @@ def instrument_stage_method(cls_name: str, method_name: str, fn):
         timings = _collector.get()
         if timings is None:
             return fn(self, *args, **kwargs)
-        record = {"depth": timings._depth, "stage": cls_name,
+        # type(self), not the defining class: a subclass inheriting
+        # transform must show under its own name in the timing tree
+        record = {"depth": timings._depth, "stage": type(self).__name__,
                   "uid": getattr(self, "uid", "?"), "method": method_name,
                   "seconds": 0.0}
         timings.records.append(record)  # pre-insert: tree order, not finish order

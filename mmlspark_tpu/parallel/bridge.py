@@ -77,6 +77,9 @@ def put_sharded(local: np.ndarray, sharding: NamedSharding) -> jax.Array:
 
 
 _gather_fns: dict[Mesh, Any] = {}
+_GATHER_CACHE_MAX = 8  # a process uses a handful of meshes; bound the cache
+# so churning through many short-lived meshes can't pin them (and their
+# compiled executables) for the process lifetime
 
 
 def gather_replicated(tree: Any, mesh: Mesh) -> Any:
@@ -89,6 +92,8 @@ def gather_replicated(tree: Any, mesh: Mesh) -> Any:
     checkpoints don't re-lower/re-compile.
     """
     if mesh not in _gather_fns:
+        while len(_gather_fns) >= _GATHER_CACHE_MAX:
+            _gather_fns.pop(next(iter(_gather_fns)))  # FIFO eviction
         _gather_fns[mesh] = jax.jit(lambda t: t,
                                     out_shardings=replicated(mesh))
     return _gather_fns[mesh](tree)

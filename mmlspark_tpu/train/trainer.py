@@ -94,6 +94,20 @@ def _make_loss(kind: str) -> Callable:
     return loss_fn
 
 
+def _epoch_order(rng, epoch: int, n: int, n_local: int,
+                 shuffle: bool) -> np.ndarray:
+    """The `n` local row indices this epoch feeds, drawn from `n_local`
+    available rows.  When partitions are unequal (n < n_local under
+    multi-host lockstep), surplus rows are not dropped: shuffling samples
+    the whole partition each epoch, and the unshuffled path rotates a
+    window so every row participates within ceil(n_local/n) epochs."""
+    if shuffle:
+        return rng.permutation(n_local)[:n]
+    if n == n_local:
+        return np.arange(n)
+    return (np.arange(n) + epoch * n) % n_local
+
+
 class Trainer:
     """Drives the jit-compiled training loop for one model."""
 
@@ -212,7 +226,8 @@ class Trainer:
         """
         cfg = self.config
         nproc = jax.process_count()
-        n = len(x)
+        n_local = len(x)
+        n = n_local
         data_size = self.mesh.shape[DATA_AXIS]
         if nproc > 1:
             if data_size % nproc:
@@ -223,10 +238,18 @@ class Trainer:
                     "host (over ICI) and scale data parallelism across "
                     "hosts (over DCN)")
             # all processes must agree on the step count or the collectives
-            # deadlock; train on the smallest partition's row count
+            # deadlock; each epoch feeds the smallest partition's row count,
+            # but surplus rows on larger partitions ROTATE into later epochs
+            # (epoch-order logic below) instead of being silently dropped
             from jax.experimental import multihost_utils
-            n = int(multihost_utils.process_allgather(
-                np.asarray(len(x))).min())
+            sizes = multihost_utils.process_allgather(np.asarray(len(x)))
+            n = int(sizes.min())
+            if n != n_local:
+                get_logger("train").warning(
+                    "unequal data partitions %s: each epoch uses %d of this "
+                    "process's %d rows (lockstep step count); surplus rows "
+                    "rotate into later epochs", np.asarray(sizes).tolist(),
+                    n, n_local)
             # save_checkpoint is a collective: every process must take the
             # checkpoint branches in lockstep or the job deadlocks
             flags = np.asarray([int(bool(cfg.checkpoint_dir)),
@@ -256,8 +279,11 @@ class Trainer:
         # step so checkpoint_every_steps boundaries stay aligned across
         # fit() calls; never sync on state.step mid-epoch
         step = int(state.step)
+        self._rows_seen = np.zeros(n_local, bool)  # coverage, inspectable
         for epoch in range(cfg.epochs):
-            order = rng.permutation(n) if cfg.shuffle_each_epoch else np.arange(n)
+            order = _epoch_order(rng, epoch, n, n_local,
+                                 cfg.shuffle_each_epoch)
+            self._rows_seen[order] = True
             losses: list = []
             for start in range(0, n, bs_local):
                 idx = order[start:start + bs_local]

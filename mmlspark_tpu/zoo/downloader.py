@@ -203,10 +203,17 @@ class RemoteRepo:
 
     def get_payload(self, schema: ModelSchema) -> bytes:
         uri = schema.uri
+        if "://" in uri:
+            # absolute URI: may live under a subdirectory or another host
+            # than base_url, but a remote-supplied .meta must not steer us
+            # to file:///etc/... or internal services (SSRF) — http(s)
+            # only, same trust level as base_url itself
+            import urllib.parse
+            if urllib.parse.urlparse(uri).scheme not in ("http", "https"):
+                raise ModelNotFoundError(
+                    f"refusing non-http(s) payload uri: {uri!r}")
         try:
             if "://" in uri:
-                # absolute URI: fetch it as stated (may live under a
-                # subdirectory or another host than base_url)
                 import urllib.request
                 with urllib.request.urlopen(
                         uri, timeout=self.read_timeout) as r:

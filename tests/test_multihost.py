@@ -106,6 +106,68 @@ def test_restore_broadcasts_from_coordinator(two_process_run):
         np.testing.assert_array_equal(r["restored_kernel"], r["kernel"])
 
 
+def test_two_process_scoring_matches_single_process(two_process_run):
+    """TPUModel.transform under 2 processes: each process's output rows must
+    equal the single-process scoring of its local partition (the reference's
+    core distributed behavior, CNTKModel.scala:215-221).  Worker 0 scores an
+    uneven partition (3 rows fewer), so step-count lockstep + padding are
+    exercised, not just the happy path."""
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.models import ModelBundle, TPUModel
+    from mmlspark_tpu.train import Trainer
+
+    worker = _load_worker_module()
+    x, y = worker.make_data()
+    ref = Trainer(worker.trainer_config())
+    bundle = ref.fit_arrays(x, y)
+    scorer = TPUModel(bundle, inputCol="features", outputCol="scores",
+                      miniBatchSize=32)
+    ref_scores = np.asarray(
+        scorer.transform(DataTable({"features": x}))["scores"])
+
+    rows = len(x) // 2
+    r0 = np.load(os.path.join(two_process_run, "result0.npz"))
+    r1 = np.load(os.path.join(two_process_run, "result1.npz"))
+    assert r0["scores"].shape == (rows - 3, 2)
+    assert r1["scores"].shape == (rows, 2)
+    np.testing.assert_allclose(r0["scores"], ref_scores[:rows - 3],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(r1["scores"], ref_scores[rows:],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_unequal_partitions_rotate_all_rows(two_process_run):
+    """fit_arrays with 20-vs-12-row partitions: lockstep feeds 12 rows per
+    epoch, but every local row must participate across epochs (the rotation
+    fix for silent surplus-row dropping)."""
+    for pid in range(2):
+        r = np.load(os.path.join(two_process_run, f"result{pid}.npz"))
+        assert int(r["uneq_rows_seen"]) == int(r["uneq_rows_total"])
+
+
+def test_epoch_order_rotation_covers_all_rows():
+    """Unit view of the same invariant: unshuffled rotation covers n_local
+    within ceil(n_local/n) epochs; shuffled sampling draws from the whole
+    partition."""
+    from mmlspark_tpu.train.trainer import _epoch_order
+    n, n_local = 12, 20
+    seen = np.zeros(n_local, bool)
+    for epoch in range(2):  # ceil(20/12) = 2
+        order = _epoch_order(np.random.default_rng(0), epoch, n, n_local,
+                             shuffle=False)
+        assert order.shape == (n,) and (order < n_local).all()
+        seen[order] = True
+    assert seen.all()
+    # equal partitions, unshuffled: identity order (bit-for-bit the old path)
+    np.testing.assert_array_equal(
+        _epoch_order(np.random.default_rng(0), 0, 8, 8, False), np.arange(8))
+    # shuffled: a permutation prefix drawn from the FULL partition
+    rng = np.random.default_rng(1)
+    orders = {tuple(_epoch_order(rng, e, n, n_local, True)) for e in range(6)}
+    assert len(orders) > 1
+    assert any(i >= n for o in orders for i in o)  # reaches beyond first n
+
+
 def test_only_coordinator_writes_checkpoints(two_process_run):
     assert os.path.exists(
         os.path.join(two_process_run, "ckpt0", "checkpoint.msgpack"))

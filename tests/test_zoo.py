@@ -72,6 +72,24 @@ def test_hostile_schema_name_rejected(tmp_path, source_repo):
             dl.download_model(source_repo, hostile)
 
 
+def test_remote_payload_uri_scheme_restricted(tmp_path, source_repo):
+    """A remote-supplied .meta with a file:// (or ftp://, or any non-http)
+    payload uri is an SSRF/local-file read within the hostile-manifest
+    threat model; RemoteRepo must refuse it without opening the uri."""
+    import dataclasses
+
+    from mmlspark_tpu.zoo.downloader import ModelNotFoundError, RemoteRepo
+    repo = RemoteRepo("http://127.0.0.1:1/unused")
+    src = list(source_repo.list_schemas())[0]
+    secret = tmp_path / "secret.bin"
+    secret.write_bytes(b"host file contents")
+    for bad in (f"file://{secret}", "ftp://internal/payload",
+                "gopher://internal:70/x"):
+        hostile = dataclasses.replace(src, uri=bad)
+        with pytest.raises(ModelNotFoundError, match="non-http"):
+            repo.get_payload(hostile)
+
+
 def test_download_unknown_model(tmp_path, source_repo):
     dl = ModelDownloader(str(tmp_path / "cache"))
     with pytest.raises(ModelNotFoundError):

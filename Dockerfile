@@ -1,0 +1,33 @@
+# mmlspark_tpu development/CI image (the reference's tools/docker/Dockerfile
+# analogue: its image bundled Spark+CNTK+OpenCV; here the stack is
+# pip-resolvable and the only system deps are the C++ toolchain and image
+# codec headers for the native decoder).
+#
+#   docker build -t mmlspark_tpu .
+#   docker run --rm mmlspark_tpu                    # run the gate
+#   docker run --rm -it mmlspark_tpu bash           # dev shell
+#
+# On TPU VMs, base on an image with the libtpu stack instead and install
+# jax[tpu]; this image runs the 8-virtual-device CPU mesh.
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make libjpeg-dev libpng-dev \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/mmlspark_tpu
+COPY pyproject.toml README.md ./
+COPY mmlspark_tpu ./mmlspark_tpu
+COPY tests ./tests
+COPY examples ./examples
+COPY scripts ./scripts
+COPY docs ./docs
+COPY bench.py __graft_entry__.py Makefile ./
+
+RUN pip install --no-cache-dir jax flax optax chex einops numpy pytest pillow \
+    && pip install --no-cache-dir -e . --no-deps --no-build-isolation
+
+# build the native decoder at image build time (fails soft to PIL)
+RUN python -c "from mmlspark_tpu import native_loader; native_loader.build_native()" || true
+
+CMD ["bash", "scripts/check.sh"]

@@ -1,0 +1,31 @@
+# Developer entry points (the reference's `runme` + sbt targets,
+# tools/runme/runme.sh:30-52 + src/project/build.scala).
+.PHONY: check test lint bench bench-smoke tpu-floors install docs clean
+
+check:            ## full gate: syntax + lint + suite + dryrun + bench smoke
+	bash scripts/check.sh
+
+test:             ## CPU-mesh test suite
+	python -m pytest tests/ -q
+
+lint:             ## AST lint (unused imports, bare except, tabs)
+	python scripts/lint.py
+
+bench:            ## full benchmark on the available backend
+	python bench.py
+
+bench-smoke:      ## tiny-size bench (JSON contract check)
+	python bench.py --smoke
+
+tpu-floors:       ## throughput/MFU floors on a real TPU chip
+	MMLSPARK_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_perf_floor.py -q
+
+install:          ## editable install of the package
+	pip install -e . --no-deps --no-build-isolation
+
+docs:             ## regenerate generated API docs (gated by test_api_doc_in_sync)
+	python -c "from mmlspark_tpu.utils import api_summary; open('docs/api.md','w').write(api_summary())"
+
+clean:
+	rm -rf build dist *.egg-info .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

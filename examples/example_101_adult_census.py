@@ -8,7 +8,6 @@ every learner family with `FindBestModel`, and evaluate the winner with
 (utils/demo_data.py) because this build is air-gapped.
 """
 
-import numpy as np
 
 from mmlspark_tpu.ml import (
     ComputeModelStatistics,

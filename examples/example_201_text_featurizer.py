@@ -6,7 +6,6 @@ TextFeaturizer chain (tokenize -> stop words -> n-grams -> hashing TF ->
 IDF), densify, and train a classifier on the result.
 """
 
-import numpy as np
 
 from mmlspark_tpu.feature import TextFeaturizer, densify_sparse_column
 from mmlspark_tpu.ml import ComputeModelStatistics, LogisticRegression, TrainClassifier

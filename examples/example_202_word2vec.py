@@ -6,7 +6,6 @@ embeddings, represent each review as its mean word vector, and train a
 classifier on the embedded documents.
 """
 
-import numpy as np
 
 from mmlspark_tpu.feature import Tokenizer, Word2Vec
 from mmlspark_tpu.ml import ComputeModelStatistics, LogisticRegression, TrainClassifier

@@ -1,0 +1,130 @@
+"""Global configuration tier: one registry for every MMLSPARK_TPU_* knob.
+
+Counterpart of the reference's two config layers — the Typesafe-config
+wrapper (Configuration.scala:18-51: packaged defaults overlaid by an
+environment-pointed file) and the `defvar` env framework the build/install
+system uses (tools/config.sh:53-60: every variable declared with defaults
+and documented provenance).  Here a variable is declared exactly once with
+its name, type, default, and doc; reads go through `get()` with precedence
+
+    programmatic override (`set()`)  >  process environment  >  default
+
+and `describe()` makes the whole surface discoverable (the reference prints
+its defvar table the same way).  Modules never call os.environ for
+MMLSPARK_TPU_* values directly — they import this registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable
+
+_PREFIX = "MMLSPARK_TPU_"
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigVar:
+    name: str              # full env name, MMLSPARK_TPU_*
+    default: Any
+    doc: str
+    ptype: Callable = str  # parser applied to env-var strings
+
+    def current(self) -> Any:
+        if self.name in _overrides:
+            return _overrides[self.name]
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        return self.ptype(raw)
+
+
+_registry: dict[str, ConfigVar] = {}
+_overrides: dict[str, Any] = {}
+
+
+def register(name: str, default: Any = None, doc: str = "",
+             ptype: Callable = str) -> ConfigVar:
+    """Declare a config variable (idempotent for identical declarations)."""
+    if not name.startswith(_PREFIX):
+        raise ValueError(f"config vars are namespaced {_PREFIX}*; got {name!r}")
+    var = ConfigVar(name, default, doc, ptype)
+    existing = _registry.get(name)
+    if existing is not None and (existing.default, existing.doc) != \
+            (default, doc):
+        raise ValueError(f"{name} already registered with different "
+                         f"default/doc; one declaration per variable")
+    _registry[name] = var
+    return var
+
+
+def get(name: str) -> Any:
+    """Typed current value: override > environment > default."""
+    if name not in _registry:
+        raise KeyError(f"unregistered config var {name!r}; known: "
+                       f"{sorted(_registry)}")
+    return _registry[name].current()
+
+
+def set(name: str, value: Any) -> None:  # noqa: A001 - mirrors Configuration.set
+    """Programmatic override (highest precedence); None removes it."""
+    if name not in _registry:
+        raise KeyError(f"unregistered config var {name!r}")
+    if value is None:
+        _overrides.pop(name, None)
+    else:
+        _overrides[name] = value
+
+
+def describe() -> list[dict]:
+    """Every registered variable with default, doc, and current value."""
+    return [{"name": v.name, "default": v.default, "doc": v.doc,
+             "current": v.current()} for v in
+            sorted(_registry.values(), key=lambda v: v.name)]
+
+
+def _intp(s: str) -> int:
+    return int(s)
+
+
+def _floatp(s: str) -> float:
+    return float(s)
+
+
+# --------------------------------------------------------------------------
+# the framework's variables (one declaration each; consumers import these)
+# --------------------------------------------------------------------------
+
+LOG_LEVEL = register(
+    "MMLSPARK_TPU_LOG_LEVEL", default=None,
+    doc="When set (DEBUG/INFO/...), the framework manages its own log "
+        "output: root logger level + stderr handler (observe/logging.py). "
+        "Unset: standard library behavior, the application configures.")
+
+NATIVE_CACHE = register(
+    "MMLSPARK_TPU_NATIVE_CACHE", default=None,
+    doc="Directory for compiled native (C++) decoder artifacts; default "
+        "~/.cache/mmlspark_tpu (native_loader.py).")
+
+COORDINATOR = register(
+    "MMLSPARK_TPU_COORDINATOR", default=None,
+    doc="host:port of the jax.distributed coordinator for multi-host runs "
+        "(the reference's MPI hostfile analogue, parallel/distributed.py).")
+
+NUM_PROCESSES = register(
+    "MMLSPARK_TPU_NUM_PROCESSES", default=None, ptype=_intp,
+    doc="Total process count of the multi-host run.")
+
+PROCESS_ID = register(
+    "MMLSPARK_TPU_PROCESS_ID", default=None, ptype=_intp,
+    doc="This process's index in the multi-host run (0 = coordinator).")
+
+TEST_PLATFORM = register(
+    "MMLSPARK_TPU_TEST_PLATFORM", default="cpu",
+    doc="Test harness: 'cpu' forces the 8-virtual-device CPU mesh; 'tpu' "
+        "runs the suite (incl. perf floors) on real chips (tests/conftest.py).")
+
+TEST_BUDGET_S = register(
+    "MMLSPARK_TPU_TEST_BUDGET_S", default=30.0, ptype=_floatp,
+    doc="Per-test duration alert budget in seconds (reference "
+        "TestBase.scala:65 alerts at 3s; XLA compiles are ~10x that).")

@@ -14,7 +14,7 @@ stable names, and round-trip through persistence.
 from __future__ import annotations
 
 import copy as _copy
-from typing import Any, Callable, Iterable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 _SENTINEL = object()
 

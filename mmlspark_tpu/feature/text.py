@@ -18,9 +18,8 @@ from typing import Optional
 
 import numpy as np
 
-from mmlspark_tpu.core.params import Param, domain
-from mmlspark_tpu.core.pipeline import (Estimator, PipelineModel, Transformer,
-                                        load_stage)
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import (Estimator, PipelineModel, Transformer)
 from mmlspark_tpu.core.table import DataTable, object_column as _object_column
 from mmlspark_tpu.feature.hashing import concat_sparse_rows, hash_token_lists
 

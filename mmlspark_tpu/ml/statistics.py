@@ -16,7 +16,7 @@ from typing import Optional
 import numpy as np
 
 from mmlspark_tpu.core.params import Param
-from mmlspark_tpu.core.pipeline import Evaluator, Transformer
+from mmlspark_tpu.core.pipeline import Evaluator
 from mmlspark_tpu.core.schema import SchemaConstants, find_score_columns
 from mmlspark_tpu.core.table import DataTable
 

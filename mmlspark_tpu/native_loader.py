@@ -30,7 +30,8 @@ _load_failed = False
 
 
 def _cache_dir() -> str:
-    d = os.environ.get("MMLSPARK_TPU_NATIVE_CACHE") or os.path.join(
+    from mmlspark_tpu import config
+    d = config.NATIVE_CACHE.current() or os.path.join(
         os.path.expanduser("~"), ".cache", "mmlspark_tpu", "native")
     os.makedirs(d, exist_ok=True)
     return d

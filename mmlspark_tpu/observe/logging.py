@@ -3,14 +3,13 @@
 The reference constructs every logger as `<configured root>.<suffix>` through
 one factory so the whole framework is silenceable/redirectable from a single
 knob.  Same here: `get_logger("ml.statistics")` -> logger
-"mmlspark_tpu.ml.statistics", with the root level driven by
-MMLSPARK_TPU_LOG_LEVEL (registered in mmlspark_tpu.config).
+"mmlspark_tpu.ml.statistics", with the root level driven by the
+MMLSPARK_TPU_LOG_LEVEL variable of the mmlspark_tpu.config registry.
 """
 
 from __future__ import annotations
 
 import logging
-import os
 
 LOG_ROOT = "mmlspark_tpu"
 _configured = False
@@ -20,8 +19,9 @@ def _configure_root() -> None:
     global _configured
     if _configured:
         return
+    from mmlspark_tpu import config
     root = logging.getLogger(LOG_ROOT)
-    level = os.environ.get("MMLSPARK_TPU_LOG_LEVEL")
+    level = config.LOG_LEVEL.current()
     if level is not None:
         # the user asked the framework to manage its own output: set the
         # level and attach a handler so records print without propagating

@@ -9,11 +9,11 @@ its shard (no full-batch replication, no per-row copies).
 
 from __future__ import annotations
 
-from typing import Any, Mapping, Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, batch_sharding, replicated
 

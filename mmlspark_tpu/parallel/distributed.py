@@ -12,7 +12,6 @@ xmanager, bash over ssh) that starts N identical processes works.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Optional
 
 import jax
@@ -35,16 +34,12 @@ class DistributedConfig:
 
     @staticmethod
     def from_env() -> "DistributedConfig":
+        from mmlspark_tpu import config
         return DistributedConfig(
-            coordinator_address=os.environ.get("MMLSPARK_TPU_COORDINATOR"),
-            num_processes=_int_env("MMLSPARK_TPU_NUM_PROCESSES"),
-            process_id=_int_env("MMLSPARK_TPU_PROCESS_ID"),
+            coordinator_address=config.COORDINATOR.current(),
+            num_processes=config.NUM_PROCESSES.current(),
+            process_id=config.PROCESS_ID.current(),
         )
-
-
-def _int_env(name: str) -> Optional[int]:
-    v = os.environ.get(name)
-    return int(v) if v is not None else None
 
 
 _initialized = False

@@ -15,7 +15,7 @@ the first-class long-context support the TPU build adds.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

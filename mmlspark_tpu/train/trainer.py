@@ -22,7 +22,6 @@ standard drop-nothing tradeoff.
 
 from __future__ import annotations
 
-import dataclasses
 import inspect
 import os
 import time

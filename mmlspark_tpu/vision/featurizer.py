@@ -15,7 +15,6 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-import numpy as np
 
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Transformer

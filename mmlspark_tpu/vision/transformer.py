@@ -17,7 +17,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-import jax
 import numpy as np
 
 from mmlspark_tpu.core.params import Param

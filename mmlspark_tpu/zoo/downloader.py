@@ -18,10 +18,8 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import io
 import json
 import os
-import shutil
 import tempfile
 import zipfile
 from typing import Iterable, Optional

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Clean machine -> passing suite (the reference's runme installer,
+# tools/runme/runme.sh:30-52, minus its Spark/CNTK downloads: everything
+# here is pip-resolvable).
+#
+#   scripts/bootstrap.sh [venv-dir]    # default ./.venv
+#
+# Requires: python3.10+, a C++ toolchain (g++) with libjpeg/libpng headers
+# for the native decoder (optional — the framework falls back to PIL).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VENV="${1:-.venv}"
+PY="${PYTHON:-python3}"
+
+if [[ ! -d "$VENV" ]]; then
+    echo "== creating venv at $VENV =="
+    "$PY" -m venv "$VENV"
+fi
+# shellcheck disable=SC1091
+source "$VENV/bin/activate"
+
+echo "== installing dependencies =="
+# TPU machines: replace with `pip install 'jax[tpu]'` per the JAX install
+# matrix; CPU wheels are enough for the virtual-device test mesh.
+pip install --upgrade pip -q
+pip install -q "jax" "flax" "optax" "chex" "einops" "numpy" "pytest" "pillow"
+
+echo "== installing mmlspark_tpu (editable) =="
+pip install -e . --no-deps --no-build-isolation -q
+
+echo "== pre-building the native decoder (optional) =="
+python - <<'EOF'
+from mmlspark_tpu import native_loader
+try:
+    native_loader.build_native()
+    print("native decoder built")
+except Exception as e:
+    print(f"native decoder unavailable ({e}); PIL fallback will be used")
+EOF
+
+echo "== running the gate =="
+bash scripts/check.sh
+
+echo "BOOTSTRAP OK — activate with: source $VENV/bin/activate"

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# The build gate (reference: scalastyle + -Xfatal-warnings wired into every
+# build, src/project/build.scala:47-58,78).  Everything a change must pass
+# before merging: syntax, lint, the suite, and the bench contract.
+#
+#   scripts/check.sh           # lint + CPU-mesh suite + smoke bench
+#   scripts/check.sh --tpu     # additionally: perf floors on the real chip
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== compileall (syntax) =="
+python -m compileall -q mmlspark_tpu tests examples scripts bench.py __graft_entry__.py
+
+echo "== lint (scripts/lint.py) =="
+python scripts/lint.py
+
+echo "== test suite (8-virtual-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== multichip dryrun =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== bench smoke (JSON contract) =="
+python bench.py --smoke
+
+if [[ "${1:-}" == "--tpu" ]]; then
+    echo "== perf floors on real TPU =="
+    MMLSPARK_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_perf_floor.py -q
+fi
+
+echo "CHECK OK"

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Minimal AST linter (the image ships no ruff/flake8; the reference wires
+scalastyle + -Xfatal-warnings into every build, src/project/build.scala:47-58
+— this is the equivalent gate, run by scripts/check.sh).
+
+Checks, per file:
+  * unused imports (conservative: a name imported but never referenced;
+    `__init__.py` re-export surfaces and `# noqa` lines are exempt)
+  * bare `except:` clauses
+  * tabs in indentation
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOTS = ["mmlspark_tpu", "tests", "examples", "scripts",
+         "bench.py", "__graft_entry__.py"]
+
+
+def iter_py(paths):
+    for p in paths:
+        if p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                yield from (os.path.join(root, f) for f in files
+                            if f.endswith(".py"))
+
+
+def used_names(tree: ast.AST) -> set[str]:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # a.b.c -> root name a
+            inner = node
+            while isinstance(inner, ast.Attribute):
+                inner = inner.value
+            if isinstance(inner, ast.Name):
+                used.add(inner.id)
+    return used
+
+
+def check_file(path: str) -> list[str]:
+    with open(path) as f:
+        src = f.read()
+    problems = []
+    lines = src.splitlines()
+    for i, line in enumerate(lines, 1):
+        stripped = line.lstrip("\t ")
+        indent = line[:len(line) - len(stripped)]
+        if "\t" in indent:
+            problems.append(f"{path}:{i}: tab in indentation")
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(f"{path}:{node.lineno}: bare except:")
+
+    if os.path.basename(path) != "__init__.py":
+        used = used_names(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                    continue
+                line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+                if "noqa" in line:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if bound not in used:
+                        problems.append(
+                            f"{path}:{node.lineno}: unused import '{bound}'")
+    return problems
+
+
+def main() -> int:
+    os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    problems = []
+    for path in iter_py(ROOTS):
+        problems.extend(check_file(path))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\n{len(problems)} lint problem(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

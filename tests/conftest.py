@@ -9,7 +9,9 @@ import os
 
 # MMLSPARK_TPU_TEST_PLATFORM=tpu runs the suite against the real chip
 # (scripts/check.sh uses it for the TPU-gated perf floors); default is the
-# 8-virtual-device CPU mesh.
+# 8-virtual-device CPU mesh.  Bootstrap read via os.environ: this gates JAX
+# initialization, which must happen before the package (and its config
+# registry) can be imported; the var is still declared in mmlspark_tpu.config.
 _platform = os.environ.get("MMLSPARK_TPU_TEST_PLATFORM", "cpu")
 if _platform == "cpu":
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -40,7 +42,9 @@ def pytest_configure(config):
 # -- test-duration alert budgets (reference TestBase.scala:47-68,138-153:
 # alert at >3s/test, >10s/suite; XLA compiles make those numbers 10x here,
 # MMLSPARK_TPU_TEST_BUDGET_S overrides) -------------------------------------
-_TEST_BUDGET_S = float(os.environ.get("MMLSPARK_TPU_TEST_BUDGET_S", "30"))
+from mmlspark_tpu import config as _mml_config
+
+_TEST_BUDGET_S = float(_mml_config.TEST_BUDGET_S.current())
 _over_budget: list = []
 
 

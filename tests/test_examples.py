@@ -5,9 +5,7 @@ in-process with thresholds asserted)."""
 
 import importlib.util
 import os
-import sys
 
-import numpy as np
 import pytest
 
 EXAMPLES_DIR = os.path.join(

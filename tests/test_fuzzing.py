@@ -15,10 +15,9 @@ import keyword
 import numpy as np
 import pytest
 
-from mmlspark_tpu.core.pipeline import (Estimator, PipelineStage, Transformer,
+from mmlspark_tpu.core.pipeline import (Estimator, Transformer,
                                         load_stage)
 from mmlspark_tpu.utils import all_stage_classes, api_summary, generate_table
-from mmlspark_tpu.utils.datagen import ColumnOptions
 
 
 # ---------------------------------------------------------------- fixtures ---
@@ -49,6 +48,36 @@ def _tiny_bundle():
     from mmlspark_tpu.models import MLPClassifier, ModelBundle
     return ModelBundle.init(MLPClassifier(hidden_sizes=(4,), num_classes=2),
                             (1, 2), seed=0)
+
+
+def _conv_bundle():
+    from mmlspark_tpu.models import ConvNetCIFAR10, ModelBundle
+    return ModelBundle.init(
+        ConvNetCIFAR10(widths=(4, 4, 8), dense_width=8, dtype=np.float32),
+        (1, 8, 8, 3), seed=0)
+
+
+def _scored_table(seed=0, n=24):
+    """A classification-scored table with the mml score metadata set (what
+    evaluators consume downstream of any classifier)."""
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.core.schema import SchemaConstants, set_score_column
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    pred = np.where(rng.random(n) < 0.8, y, 1 - y)
+    p1 = np.clip(pred + rng.normal(0, .1, n), 0.01, 0.99)
+    t = DataTable({"label": y, "prediction": pred,
+                   "prob": np.stack([1 - p1, p1], axis=1)})
+    set_score_column(t, "fuzz", "prediction",
+                     SchemaConstants.SCORED_LABELS_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    set_score_column(t, "fuzz", "label",
+                     SchemaConstants.TRUE_LABELS_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    set_score_column(t, "fuzz", "prob",
+                     SchemaConstants.SCORED_PROBABILITIES_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    return t
 
 
 # stage-name -> () -> (instance, table or None)
@@ -95,9 +124,8 @@ def _fixtures():
         "PartitionSample": lambda: (
             PartitionSample(mode="Head", count=5), gen),
         "MultiColumnAdapter": lambda: (
-            MultiColumnAdapter(
-                DataConversion(convertTo="double").copy(),
-                inputCols=[], outputCols=[]), None),
+            MultiColumnAdapter(  # base must carry inputCol/outputCol params
+                Tokenizer(), inputCols=["txt"], outputCols=["txt_tok"]), txt),
         "Tokenizer": lambda: (Tokenizer(inputCol="txt"), txt),
         "StopWordsRemover": lambda: (StopWordsRemover(inputCol="tokens"), txt),
         "NGram": lambda: (NGram(inputCol="tokens"), txt),
@@ -144,10 +172,18 @@ def _fixtures():
         "TrainRegressor": lambda: (
             TrainRegressor(LinearRegression(), labelCol="label"),
             ml.rename({"features": "feats"})),
-        "ComputeModelStatistics": lambda: (ComputeModelStatistics(), None),
+        "ComputeModelStatistics": lambda: (
+            ComputeModelStatistics(), _scored_table()),
         "ComputePerInstanceStatistics": lambda: (
-            ComputePerInstanceStatistics(), None),
-        "FindBestModel": lambda: (FindBestModel(), None),
+            ComputePerInstanceStatistics(), _scored_table()),
+        "FindBestModel": lambda: (
+            FindBestModel([
+                TrainClassifier(LogisticRegression(), labelCol="label")
+                .fit(ml.rename({"features": "feats"})),
+                TrainClassifier(LogisticRegression(regParam=1.0),
+                                labelCol="label")
+                .fit(ml.rename({"features": "feats"})),
+            ]), ml.rename({"features": "feats"})),
         "TPULearner": lambda: (
             TPULearner(TrainerConfig(
                 architecture="MLPClassifier",
@@ -160,7 +196,8 @@ def _fixtures():
         "ImageTransformer": lambda: (
             ImageTransformer().resize(4, 4), img),
         "UnrollImage": lambda: (UnrollImage(), img),
-        "ImageFeaturizer": lambda: (ImageFeaturizer(), None),
+        "ImageFeaturizer": lambda: (
+            ImageFeaturizer(_conv_bundle(), layerName="dense1"), img),
         "Pipeline": lambda: (
             Pipeline([SelectColumns(cols=["double_0", "label"])]), gen),
     }
@@ -226,8 +263,9 @@ def test_save_load_roundtrip(stage_name, tmp_path):
 @pytest.mark.parametrize("stage_name", sorted(_fixtures()))
 def test_fit_transform_fuzz(stage_name, tmp_path):
     stage, table = _fixtures()[stage_name]()
-    if table is None:
-        pytest.skip("stage needs richer context; covered by module tests")
+    assert table is not None, (
+        f"{stage_name} has no fuzz fixture — every stage must be "
+        "fit/transform-fuzzable (Fuzzing.scala:35-104's universal invariant)")
     if isinstance(stage, Estimator):
         model = stage.fit(table)
         assert isinstance(model, Transformer)

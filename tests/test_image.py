@@ -2,7 +2,6 @@
 image-featurizer/, ImageTransformerSuite, ImageReaderSuite)."""
 
 import io
-import os
 import zipfile
 
 import numpy as np

@@ -113,7 +113,7 @@ def test_two_process_scoring_matches_single_process(two_process_run):
     uneven partition (3 rows fewer), so step-count lockstep + padding are
     exercised, not just the happy path."""
     from mmlspark_tpu import DataTable
-    from mmlspark_tpu.models import ModelBundle, TPUModel
+    from mmlspark_tpu.models import TPUModel
     from mmlspark_tpu.train import Trainer
 
     worker = _load_worker_module()

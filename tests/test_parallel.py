@@ -14,7 +14,6 @@ from mmlspark_tpu.parallel import (
     device_to_host,
     make_mesh,
     pad_to_multiple,
-    replicated,
     shard_batch,
     shard_table_columns,
 )

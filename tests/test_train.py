@@ -6,7 +6,7 @@ import pytest
 from mmlspark_tpu import DataTable
 from mmlspark_tpu.models import ModelBundle, TPUModel
 from mmlspark_tpu.models.definitions import MLPClassifier
-from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from mmlspark_tpu.parallel.mesh import MeshSpec
 from mmlspark_tpu.train import Trainer, TrainerConfig, TPULearner
 
 

@@ -6,7 +6,6 @@ import pytest
 
 from mmlspark_tpu import DataTable
 from mmlspark_tpu.core.pipeline import load_stage
-from mmlspark_tpu.core.schema import SchemaConstants
 from mmlspark_tpu.ml import (
     ComputeModelStatistics,
     DecisionTreeClassifier,

@@ -1,6 +1,5 @@
 """Model zoo tests (reference downloader/, DownloaderSuite)."""
 
-import json
 import os
 
 import numpy as np
@@ -11,7 +10,6 @@ from mmlspark_tpu.zoo import (
     LocalRepo,
     ModelDownloader,
     ModelNotFoundError,
-    ModelSchema,
     create_builtin_repo,
 )
 

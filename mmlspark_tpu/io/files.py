@@ -56,6 +56,28 @@ def _zip_entries(path: str, sample_ratio: float,
             yield f"{path}/{info.filename}", zf.read(info)
 
 
+def iter_binary_files(path: str, recursive: bool = False,
+                      sample_ratio: float = 1.0, inspect_zip: bool = True,
+                      pattern: Optional[str] = None,
+                      seed: int = 0) -> Iterator[tuple[str, bytes]]:
+    """Stream (path, bytes) pairs one file at a time — the out-of-core
+    ingestion primitive (the reference streams partitions the same way,
+    BinaryFileReader.scala:28-69).  Only one file's bytes are resident at a
+    time; corpus size is unbounded by host RAM.
+    """
+    if not 0.0 <= sample_ratio <= 1.0:
+        raise ValueError(f"sample_ratio must be in [0,1], got {sample_ratio}")
+    rng = np.random.default_rng(seed)
+    for p in list_files(path, recursive, pattern):
+        if inspect_zip and zipfile.is_zipfile(p):
+            yield from _zip_entries(p, sample_ratio, rng)
+            continue
+        if sample_ratio < 1.0 and rng.random() > sample_ratio:
+            continue
+        with open(p, "rb") as f:
+            yield p, f.read()
+
+
 def read_binary_files(path: str, recursive: bool = False,
                       sample_ratio: float = 1.0, inspect_zip: bool = True,
                       pattern: Optional[str] = None,
@@ -63,24 +85,16 @@ def read_binary_files(path: str, recursive: bool = False,
     """Read files into a (path, bytes) table.
 
     sample_ratio subsamples FILES (not bytes), mirroring SamplePathFilter;
-    zips are expanded into entries when inspect_zip (ZipIterator).
+    zips are expanded into entries when inspect_zip (ZipIterator).  For
+    corpora larger than host RAM use `iter_binary_files` /
+    `read_images_iter` instead.
     """
-    if not 0.0 <= sample_ratio <= 1.0:
-        raise ValueError(f"sample_ratio must be in [0,1], got {sample_ratio}")
-    rng = np.random.default_rng(seed)
     paths: list[str] = []
     blobs: list[bytes] = []
-    for p in list_files(path, recursive, pattern):
-        if inspect_zip and zipfile.is_zipfile(p):
-            for vpath, data in _zip_entries(p, sample_ratio, rng):
-                paths.append(vpath)
-                blobs.append(data)
-            continue
-        if sample_ratio < 1.0 and rng.random() > sample_ratio:
-            continue
-        with open(p, "rb") as f:
-            blobs.append(f.read())
+    for p, data in iter_binary_files(path, recursive, sample_ratio,
+                                     inspect_zip, pattern, seed):
         paths.append(p)
+        blobs.append(data)
     table = DataTable({"path": object_column(paths),
                        "bytes": object_column(blobs)})
     meta = ColumnMeta(binary=BinaryFileSchema(path_col="path"))

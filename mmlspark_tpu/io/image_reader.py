@@ -15,9 +15,11 @@ from typing import Optional
 
 import numpy as np
 
+from typing import Iterator
+
 from mmlspark_tpu.core.schema import ColumnMeta, ImageSchema
 from mmlspark_tpu.core.table import DataTable, object_column
-from mmlspark_tpu.io.files import read_binary_files
+from mmlspark_tpu.io.files import iter_binary_files, read_binary_files
 from mmlspark_tpu.native_loader import native_decode
 
 
@@ -71,13 +73,12 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
     if resize_to is not None and images:
         from mmlspark_tpu.ops.image import resize
         h, w = resize_to
-        # the dense-tensor contract needs one channel count too: widen
-        # gray to 3 channels when the set is mixed (OpenCV imdecode's
-        # default always-BGR behavior)
-        n_channels = {img.shape[2] for img in images}
-        if len(n_channels) > 1:
-            images = [np.repeat(img, 3, axis=2) if img.shape[2] == 1 else img
-                      for img in images]
+        # the dense-tensor contract is deterministic: resize_to always
+        # yields 3 channels (OpenCV imdecode's default always-BGR
+        # behavior), so the streaming reader — which cannot see the whole
+        # corpus to decide — produces identical output
+        images = [np.repeat(img, 3, axis=2) if img.shape[2] == 1 else img
+                  for img in images]
         # group by source shape so each shape compiles once and the whole
         # group resizes in one batched device dispatch
         by_shape: dict[tuple, list[int]] = {}
@@ -102,3 +103,86 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
         return table
     return DataTable({"path": object_column(paths),
                       "image": object_column(images)})
+
+
+def _dense_batch(paths: list, images: list) -> DataTable:
+    arr = np.stack(images)
+    table = DataTable({"path": object_column(paths), "image": arr})
+    table.set_meta("image", ColumnMeta(image=ImageSchema(
+        height=arr.shape[1], width=arr.shape[2], channels=arr.shape[3])))
+    return table
+
+
+def read_images_iter(path: str, batch_size: int = 256,
+                     recursive: bool = False, sample_ratio: float = 1.0,
+                     inspect_zip: bool = True,
+                     resize_to: Optional[tuple] = None,
+                     drop_failures: bool = True,
+                     pattern: Optional[str] = None,
+                     seed: int = 0) -> Iterator[DataTable]:
+    """Stream a directory/glob/zip of images as dense fixed-shape batches.
+
+    The out-of-core face of `read_images` (reference streams partitions,
+    BinaryFileReader.scala:28-69): yields (path, image) tables of at most
+    `batch_size` rows, decoding lazily — at any moment only one batch of
+    decoded pixels is resident, so corpus size is unbounded by host RAM.
+    Feed the result to `TPUModel.transform_batches` for streaming scoring.
+
+    Every batch is dense (N, H, W, C) uint8: with resize_to=(H, W) decoded
+    images are batch-resized on device to (H, W, 3) — the same
+    deterministic 3-channel contract as `read_images` — while without it
+    all images must share one shape (a shape mismatch raises; streaming
+    cannot re-group shapes after the fact the way the materializing reader
+    does).
+    """
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+    paths: list = []
+    images: list = []
+    first_shape: Optional[tuple] = None
+
+    def flush() -> DataTable:
+        nonlocal paths, images
+        if resize_to is not None:
+            from mmlspark_tpu.ops.image import resize
+            h, w = resize_to
+            fixed = [np.repeat(im, 3, axis=2) if im.shape[2] == 1 else im
+                     for im in images]
+            by_shape: dict[tuple, list[int]] = {}
+            for i, im in enumerate(fixed):
+                by_shape.setdefault(im.shape, []).append(i)
+            out: list = [None] * len(fixed)
+            for _, idxs in by_shape.items():
+                batch = np.stack([fixed[i] for i in idxs])
+                res = np.clip(np.rint(np.asarray(resize(batch, h, w))),
+                              0, 255).astype(np.uint8)
+                for j, i in enumerate(idxs):
+                    out[i] = res[j]
+            table = _dense_batch(paths, out)
+        else:
+            table = _dense_batch(paths, images)
+        paths, images = [], []
+        return table
+
+    for p, data in iter_binary_files(path, recursive=recursive,
+                                     sample_ratio=sample_ratio,
+                                     inspect_zip=inspect_zip,
+                                     pattern=pattern, seed=seed):
+        img = decode_bytes(data)
+        if img is None:
+            if drop_failures:
+                continue
+            raise ValueError(f"could not decode image: {p}")
+        if resize_to is None:
+            if first_shape is None:
+                first_shape = img.shape
+            elif img.shape != first_shape:
+                raise ValueError(
+                    f"streaming without resize_to needs uniform shapes; "
+                    f"{p} is {img.shape}, stream started with {first_shape}")
+        paths.append(p)
+        images.append(img)
+        if len(images) >= batch_size:
+            yield flush()
+    if images:
+        yield flush()

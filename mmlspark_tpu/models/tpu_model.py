@@ -18,7 +18,7 @@ graph.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Iterator, Optional
 
 import jax
 import jax.numpy as jnp
@@ -154,16 +154,16 @@ class TPUModel(Transformer):
             result = self._transform_multihost(col, mesh, variables,
                                                apply_fn, bs)
             return table.with_column(self.outputCol, result)
+        if dev_col is None:
+            # ONE canonical pipelined dispatch loop (transform_batches):
+            # a single table is a one-element stream
+            [scored] = list(self.transform_batches([table]))
+            return scored
         sharding = batch_sharding(mesh)
 
-        # Pipelined dispatch: enqueue transfer+compute for a window of
-        # batches before fetching, so host->device transfers overlap with
-        # device compute (the reference's JNI loop was fully synchronous
-        # per batch, CNTKModel.scala:63-92).  Each output's device->host
-        # copy is started asynchronously the moment its compute is enqueued:
-        # over a high-latency link (tunneled chips) serialized blocking
-        # fetches cost a full round-trip each, while concurrent async
-        # copies overlap with later transfers and compute.
+        # CheckpointData fast path: the column is already HBM-resident —
+        # batches are on-device slices (re-sharded, no host transfer), with
+        # the same windowed async-fetch pipeline as the streaming loop.
         window = 8
         n = len(col)
         in_flight: list[tuple[Any, int]] = []
@@ -175,16 +175,12 @@ class TPUModel(Transformer):
                 results.append(np.asarray(out)[:valid])
 
         for start in range(0, n, bs):
-            if dev_col is not None:
-                chunk = dev_col[start:start + bs]
-                valid = int(chunk.shape[0])
-                if valid < bs:
-                    pad = [(0, bs - valid)] + [(0, 0)] * (chunk.ndim - 1)
-                    chunk = jnp.pad(chunk, pad)
-                dev = jax.device_put(chunk, sharding)  # on-device reshard
-            else:
-                chunk, valid = pad_to_multiple(col[start:start + bs], bs)
-                dev = jax.device_put(chunk, sharding)
+            chunk = dev_col[start:start + bs]
+            valid = int(chunk.shape[0])
+            if valid < bs:
+                pad = [(0, bs - valid)] + [(0, 0)] * (chunk.ndim - 1)
+                chunk = jnp.pad(chunk, pad)
+            dev = jax.device_put(chunk, sharding)  # on-device reshard
             out = apply_fn(variables, dev)
             try:
                 out.copy_to_host_async()
@@ -196,14 +192,94 @@ class TPUModel(Transformer):
         if results:
             result = np.concatenate(results, axis=0)
         else:
-            # preserve the model's output shape for zero-row tables
-            var_shapes = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), variables)
-            out_shape = jax.eval_shape(
-                apply_fn, var_shapes,
-                jax.ShapeDtypeStruct((bs,) + col.shape[1:], col.dtype))
-            result = np.zeros((0,) + out_shape.shape[1:], out_shape.dtype)
+            result = self._empty_output(col, variables, apply_fn, bs)
         return table.with_column(self.outputCol, result)
+
+    def _empty_output(self, col, variables, apply_fn, bs: int) -> np.ndarray:
+        """Zero-row result preserving the model's output shape/dtype."""
+        var_shapes = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), variables)
+        out_shape = jax.eval_shape(
+            apply_fn, var_shapes,
+            jax.ShapeDtypeStruct((bs,) + col.shape[1:], col.dtype))
+        return np.zeros((0,) + out_shape.shape[1:], out_shape.dtype)
+
+    def transform_batches(self, tables) -> Iterator[DataTable]:
+        """Streaming scoring: for each incoming table (e.g. from
+        `read_images_iter`) yield it back with the output column appended.
+
+        Out-of-core by construction — only the dispatch window's batches are
+        resident on host or in HBM, so corpus size is unbounded (reference
+        BinaryFileReader.scala:28-69 streams partitions the same way).  The
+        pipelined window is kept OPEN across table boundaries: the
+        transfer link never drains between tables, unlike calling
+        `transform` per table, which would pay a full round-trip flush each
+        time (ruinous over high-latency links).
+        """
+        self._check_required()
+        in_col = self.inputCol
+        if in_col is None:
+            raise ValueError("TPUModel: inputCol is not set")
+        mesh, variables, apply_fn = self._device_state()
+        bs = self.miniBatchSize
+        n_data = mesh.shape["data"]
+        bs = max(bs, n_data) - (max(bs, n_data) % n_data) or n_data
+        if jax.process_count() > 1:
+            # per-table lockstep path (no cross-table window: every process
+            # must agree on dispatch order)
+            for table in tables:
+                yield self.transform(table)
+            return
+        sharding = batch_sharding(mesh)
+        window = 8
+        in_flight: list[tuple[Any, int, dict]] = []
+        ready: list[DataTable] = []
+        pending: list[dict] = []
+
+        def drain(limit: int):
+            while len(in_flight) > limit:
+                out, valid, rec = in_flight.pop(0)
+                rec["parts"].append(np.asarray(out)[:valid])
+                rec["n_left"] -= 1
+            while pending and pending[0]["n_left"] == 0:
+                rec = pending.pop(0)
+                result = (rec["parts"][0] if len(rec["parts"]) == 1
+                          else np.concatenate(rec["parts"], axis=0))
+                ready.append(
+                    rec["table"].with_column(self.outputCol, result))
+
+        for table in tables:
+            col = table[in_col]
+            if col.dtype == object:
+                col = (np.stack([np.asarray(v, np.float32) for v in col])
+                       if len(col) else np.zeros((0, 1), np.float32))
+            n = len(col)
+            if n == 0:
+                # an empty record rides the ordered pending queue with its
+                # result pre-filled — NO drain: an interleaved empty table
+                # must not stall the cross-table pipeline
+                pending.append({"table": table, "n_left": 0, "parts": [
+                    self._empty_output(col, variables, apply_fn, bs)]})
+                drain(len(in_flight))  # flush only already-finished records
+            else:
+                rec = {"table": table, "parts": [],
+                       "n_left": -(-n // bs)}
+                pending.append(rec)
+                for start in range(0, n, bs):
+                    chunk, valid = pad_to_multiple(col[start:start + bs], bs)
+                    dev = jax.device_put(chunk, sharding)
+                    out = apply_fn(variables, dev)
+                    try:
+                        out.copy_to_host_async()
+                    except (AttributeError, RuntimeError):
+                        pass
+                    in_flight.append((out, valid, rec))
+                    drain(window)
+            while ready:
+                yield ready.pop(0)
+        drain(0)
+        while ready:
+            yield ready.pop(0)
 
     def _transform_multihost(self, col, mesh, variables, apply_fn,
                              bs: int) -> np.ndarray:

@@ -54,7 +54,8 @@ def compute_learner_grid() -> list[dict]:
             if learner_name == "NaiveBayes" and ds_name != "census_mixed":
                 continue
             # binary-only, as in the reference (TrainClassifier.scala:101-104)
-            if learner_name == "GBTClassifier" and ds_name == "blobs_3class":
+            if learner_name == "GBTClassifier" and \
+                    len(set(np.asarray(table[label]).tolist())) > 2:
                 continue
             model = TrainClassifier(make(), labelCol=label).fit(train)
             metrics = ComputeModelStatistics().transform(

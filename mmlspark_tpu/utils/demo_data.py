@@ -153,4 +153,45 @@ def grid_datasets() -> dict[str, DataTable]:
         {**{f"f{i}": x[:, i] for i in range(5)}, "label": y.astype(np.float64)})
 
     out["census_mixed"] = adult_census_like(n=400, seed=14)
+
+    # adversarial shapes, matching the reference grid's breadth (9 CSVs,
+    # benchmarkMetrics.csv:1-46) — each targets a failure mode a learner
+    # family has actually hit:
+
+    # class imbalance (~6% positives): accuracy alone is a trap; AUC matters
+    rng = np.random.default_rng(15)
+    n = 400
+    y = (rng.random(n) < 0.06).astype(np.int64)
+    x = rng.normal(0, 1.0, size=(n, 4)) + 1.6 * y[:, None]
+    out["imbalanced"] = DataTable(
+        {**{f"f{i}": x[:, i] for i in range(4)}, "label": y.astype(np.float64)})
+
+    # many classes (8) with few rows per class: per-class statistics thin out
+    x, y = _blobs(480, 6, 8, spread=5.0, noise=0.9, seed=16)
+    out["many_class"] = DataTable(
+        {**{f"f{i}": x[:, i] for i in range(6)}, "label": y.astype(np.float64)})
+
+    # collinear features: duplicated/linearly-dependent columns (the exact
+    # failure that broke LinearRegression in example 102 — normal equations
+    # blow up without the augmented-lstsq fit)
+    rng = np.random.default_rng(17)
+    base = rng.normal(0, 1, size=(300, 2))
+    x = np.column_stack([base[:, 0], base[:, 1],
+                         base[:, 0] * 2.0,                  # exact duplicate
+                         base[:, 0] + base[:, 1],           # exact sum
+                         base[:, 0] + rng.normal(0, 1e-6, 300)])  # near-dup
+    y = (base[:, 0] - base[:, 1] > 0).astype(np.int64)
+    out["collinear"] = DataTable(
+        {**{f"f{i}": x[:, i] for i in range(5)}, "label": y.astype(np.float64)})
+
+    # wide sparse one-hot-ish features (hashed-text regime): d >> informative
+    rng = np.random.default_rng(18)
+    n, d = 300, 64
+    x = (rng.random((n, d)) < 0.05).astype(np.float64)  # ~5% density
+    w = np.zeros(d)
+    w[:6] = [2.0, -2.0, 1.5, -1.5, 1.0, -1.0]
+    y = ((x @ w + rng.normal(0, 0.4, n)) > 0).astype(np.int64)
+    out["wide_sparse"] = DataTable(
+        {**{f"f{i}": x[:, i] for i in range(d)}, "label": y.astype(np.float64)})
+
     return out

@@ -45,4 +45,8 @@ def test_grid_covers_every_learner_family():
         "RandomForestClassifier", "GBTClassifier", "NaiveBayes",
         "MultilayerPerceptronClassifier"}
     datasets = {l.split(",")[0] for l in lines}
-    assert len(datasets) == 5
+    # 9 datasets, the reference grid's breadth (benchmarkMetrics.csv: 9
+    # bundled CSVs) incl. the adversarial shapes
+    assert datasets == {
+        "blobs_easy", "blobs_noisy", "xor", "blobs_3class", "census_mixed",
+        "imbalanced", "many_class", "collinear", "wide_sparse"}

@@ -44,17 +44,44 @@ def decode_bytes(data: bytes) -> Optional[np.ndarray]:
         return None
 
 
+def _resize_all(images: list, resize_to: tuple) -> list:
+    """Shared resize contract of BOTH readers: every image becomes
+    (H, W, 3) uint8 — gray widened to 3 channels deterministically (OpenCV
+    imdecode's default always-BGR behavior; the streaming reader cannot
+    see the whole corpus, so the contract must not depend on it).  Images
+    are grouped by source shape so each shape compiles once and resizes in
+    one batched device dispatch."""
+    from mmlspark_tpu.ops.image import resize
+    h, w = resize_to
+    fixed = [np.repeat(img, 3, axis=2) if img.shape[2] == 1 else img
+             for img in images]
+    by_shape: dict[tuple, list[int]] = {}
+    for i, img in enumerate(fixed):
+        by_shape.setdefault(img.shape, []).append(i)
+    out: list = [None] * len(fixed)
+    for _, idxs in by_shape.items():
+        batch = np.stack([fixed[i] for i in idxs])
+        res = np.clip(np.rint(np.asarray(resize(batch, h, w))),
+                      0, 255).astype(np.uint8)
+        for j, i in enumerate(idxs):
+            out[i] = res[j]
+    return out
+
+
 def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
                 inspect_zip: bool = True, resize_to: Optional[tuple] = None,
                 drop_failures: bool = True, pattern: Optional[str] = None,
                 seed: int = 0) -> DataTable:
     """Read a directory/glob/zip of images into a table.
 
-    Columns: `path`, `image`.  With resize_to=(H, W) (or when every image
-    shares one shape) `image` is a dense (N, H, W, C) uint8 tensor with
-    ImageSchema metadata; otherwise it is an object column of per-image
-    arrays.  Failed decodes are dropped when drop_failures (the reference's
-    per-row None filtering, ImageReader.scala:55-59) or raise otherwise.
+    Columns: `path`, `image`.  With resize_to=(H, W) `image` is a dense
+    (N, H, W, 3) uint8 tensor — ALWAYS 3 channels, grayscale widened
+    (the deterministic contract shared with `read_images_iter`).  Without
+    resize_to, uniform-shape corpora produce a dense (N, H, W, C) tensor
+    with ImageSchema metadata and mixed shapes fall back to an object
+    column of per-image arrays.  Failed decodes are dropped when
+    drop_failures (the reference's per-row None filtering,
+    ImageReader.scala:55-59) or raise otherwise.
     """
     files = read_binary_files(path, recursive=recursive,
                               sample_ratio=sample_ratio,
@@ -71,27 +98,7 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
         paths.append(p)
 
     if resize_to is not None and images:
-        from mmlspark_tpu.ops.image import resize
-        h, w = resize_to
-        # the dense-tensor contract is deterministic: resize_to always
-        # yields 3 channels (OpenCV imdecode's default always-BGR
-        # behavior), so the streaming reader — which cannot see the whole
-        # corpus to decide — produces identical output
-        images = [np.repeat(img, 3, axis=2) if img.shape[2] == 1 else img
-                  for img in images]
-        # group by source shape so each shape compiles once and the whole
-        # group resizes in one batched device dispatch
-        by_shape: dict[tuple, list[int]] = {}
-        for i, img in enumerate(images):
-            by_shape.setdefault(img.shape, []).append(i)
-        resized: list = [None] * len(images)
-        for shape, idxs in by_shape.items():
-            batch = np.stack([images[i] for i in idxs])
-            out = np.clip(np.rint(np.asarray(resize(batch, h, w))),
-                          0, 255).astype(np.uint8)
-            for j, i in enumerate(idxs):
-                resized[i] = out[j]
-        images = resized
+        images = _resize_all(images, resize_to)
 
     shapes = {img.shape for img in images}
     if len(shapes) == 1 and images:
@@ -143,24 +150,9 @@ def read_images_iter(path: str, batch_size: int = 256,
 
     def flush() -> DataTable:
         nonlocal paths, images
-        if resize_to is not None:
-            from mmlspark_tpu.ops.image import resize
-            h, w = resize_to
-            fixed = [np.repeat(im, 3, axis=2) if im.shape[2] == 1 else im
-                     for im in images]
-            by_shape: dict[tuple, list[int]] = {}
-            for i, im in enumerate(fixed):
-                by_shape.setdefault(im.shape, []).append(i)
-            out: list = [None] * len(fixed)
-            for _, idxs in by_shape.items():
-                batch = np.stack([fixed[i] for i in idxs])
-                res = np.clip(np.rint(np.asarray(resize(batch, h, w))),
-                              0, 255).astype(np.uint8)
-                for j, i in enumerate(idxs):
-                    out[i] = res[j]
-            table = _dense_batch(paths, out)
-        else:
-            table = _dense_batch(paths, images)
+        table = _dense_batch(
+            paths, _resize_all(images, resize_to) if resize_to is not None
+            else images)
         paths, images = [], []
         return table
 

@@ -200,13 +200,14 @@ class TransformerBlock(nn.Module):
     n_heads: int
     mlp_ratio: int = 4
     dtype: Dtype = jnp.bfloat16
-    attn_impl: str = "dense"          # dense | ring | ulysses
+    attn_impl: str = "dense"          # dense | flash | ring | ulysses
     seq_axis: Optional[str] = None    # mesh axis for ring/ulysses
 
     @nn.compact
     def __call__(self, x):
         from mmlspark_tpu.ops.attention import (attention, ring_attention,
                                                 ulysses_attention)
+        from mmlspark_tpu.ops.flash_attention import flash_attention
         b, s, _ = x.shape
         d_head = self.d_model // self.n_heads
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -216,6 +217,8 @@ class TransformerBlock(nn.Module):
         q, k, v = (t.reshape(shape) for t in (q, k, v))
         if self.attn_impl == "dense":
             o = attention(q, k, v, causal=True)
+        elif self.attn_impl == "flash":
+            o = flash_attention(q, k, v, causal=True)
         elif self.attn_impl == "ring":
             o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
         elif self.attn_impl == "ulysses":

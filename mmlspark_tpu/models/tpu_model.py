@@ -133,32 +133,41 @@ class TPUModel(Transformer):
             self._compiled[key] = self._make_apply(mesh, variables)
         return mesh, variables, self._compiled[key]
 
+    def _effective_batch_size(self, mesh) -> int:
+        """miniBatchSize rounded down to a data-axis multiple (floor at one
+        row per data shard); all dispatch entry points must agree on it."""
+        bs = max(self.miniBatchSize, mesh.shape["data"])
+        return bs - bs % mesh.shape["data"] or mesh.shape["data"]
+
+    @staticmethod
+    def _tensor_column(col: np.ndarray) -> np.ndarray:
+        if col.dtype == object:
+            return (np.stack([np.asarray(v, np.float32) for v in col])
+                    if len(col) else np.zeros((0, 1), np.float32))
+        return col
+
     # -- transform ------------------------------------------------------
     def transform(self, table: DataTable) -> DataTable:
         self._check_required()
         in_col = self.inputCol
         if in_col is None:
             raise ValueError("TPUModel: inputCol is not set")
-        col = table[in_col]
-        if col.dtype == object:
-            col = (np.stack([np.asarray(v, np.float32) for v in col])
-                   if len(col) else np.zeros((0, 1), np.float32))
         # CheckpointData may have pre-staged this column in device memory
         # (stages/basic.py); repeated passes then skip the host->HBM transfer.
         dev_col = getattr(table, "_device_cache", {}).get(in_col)
+        if dev_col is None and jax.process_count() == 1:
+            # ONE canonical pipelined dispatch loop (transform_batches):
+            # a single table is a one-element stream.  Delegate BEFORE any
+            # column conversion so the work isn't done twice.
+            [scored] = list(self.transform_batches([table]))
+            return scored
+        col = self._tensor_column(table[in_col])
         mesh, variables, apply_fn = self._device_state()
-        bs = self.miniBatchSize
-        n_data = mesh.shape["data"]
-        bs = max(bs, n_data) - (max(bs, n_data) % n_data) or n_data
+        bs = self._effective_batch_size(mesh)
         if jax.process_count() > 1:
             result = self._transform_multihost(col, mesh, variables,
                                                apply_fn, bs)
             return table.with_column(self.outputCol, result)
-        if dev_col is None:
-            # ONE canonical pipelined dispatch loop (transform_batches):
-            # a single table is a one-element stream
-            [scored] = list(self.transform_batches([table]))
-            return scored
         sharding = batch_sharding(mesh)
 
         # CheckpointData fast path: the column is already HBM-resident —
@@ -221,9 +230,7 @@ class TPUModel(Transformer):
         if in_col is None:
             raise ValueError("TPUModel: inputCol is not set")
         mesh, variables, apply_fn = self._device_state()
-        bs = self.miniBatchSize
-        n_data = mesh.shape["data"]
-        bs = max(bs, n_data) - (max(bs, n_data) % n_data) or n_data
+        bs = self._effective_batch_size(mesh)
         if jax.process_count() > 1:
             # per-table lockstep path (no cross-table window: every process
             # must agree on dispatch order)
@@ -249,10 +256,7 @@ class TPUModel(Transformer):
                     rec["table"].with_column(self.outputCol, result))
 
         for table in tables:
-            col = table[in_col]
-            if col.dtype == object:
-                col = (np.stack([np.asarray(v, np.float32) for v in col])
-                       if len(col) else np.zeros((0, 1), np.float32))
+            col = self._tensor_column(table[in_col])
             n = len(col)
             if n == 0:
                 # an empty record rides the ordered pending queue with its

@@ -1,5 +1,18 @@
-"""Batched device kernels (image ops, attention)."""
+"""Batched device kernels (image ops, attention — dense, ring/Ulysses
+sequence-parallel, and the Pallas flash kernel)."""
 
 from mmlspark_tpu.ops import image
+from mmlspark_tpu.ops.attention import (attention, ring_attention,
+                                        ulysses_attention)
 
-__all__ = ["image"]
+__all__ = ["image", "attention", "ring_attention", "ulysses_attention",
+           "flash_attention"]
+
+
+def __getattr__(name):
+    # flash_attention pulls jax.experimental.pallas (+ its TPU backend),
+    # a measurably slow import — load it only when asked for
+    if name == "flash_attention":
+        from mmlspark_tpu.ops.flash_attention import flash_attention
+        return flash_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

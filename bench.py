@@ -193,12 +193,40 @@ def bench_resnet50(smoke: bool) -> dict:
     }
 
 
+def bench_train_classifier(smoke: bool) -> dict:
+    """Notebook-101 workload (BASELINE.json tracked config): TrainClassifier
+    on Adult-Census-shaped mixed-type data — implicit featurization (hash +
+    one-hot + assembly) plus the jitted learner fit.  The reference pins no
+    number ('tracked, no regression'); rows/sec makes drift visible."""
+    from mmlspark_tpu.ml import (ComputeModelStatistics, LogisticRegression,
+                                 TrainClassifier)
+    from mmlspark_tpu.utils.demo_data import adult_census_like
+
+    n = 2000 if smoke else 20000
+    table = adult_census_like(n=n, seed=0)
+    t0 = time.perf_counter()
+    model = TrainClassifier(LogisticRegression(), labelCol="income").fit(table)
+    wall = time.perf_counter() - t0
+    result = ComputeModelStatistics().evaluate(model.transform(table))
+    acc = float(result.metrics["accuracy"][0])
+    assert acc > 0.7, f"sanity: train accuracy {acc}"
+    return {
+        "metric": "train_classifier_adult_census_rows_per_sec",
+        "value": round(n / wall, 1),
+        "unit": "rows/sec",
+        "vs_baseline": None,  # tracked-only (BASELINE.md: no reference number)
+        "train_wall_s": round(wall, 3),
+        "accuracy": round(acc, 4),
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true",
                         help="tiny sizes for CI schema checks")
     args = parser.parse_args()
 
+    print(json.dumps(bench_train_classifier(args.smoke)))
     # probe adjacent to each measurement — tunnel bandwidth swings over
     # minutes, and a stale probe would misattribute exactly the way the
     # probe exists to prevent

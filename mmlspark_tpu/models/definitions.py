@@ -207,7 +207,6 @@ class TransformerBlock(nn.Module):
     def __call__(self, x):
         from mmlspark_tpu.ops.attention import (attention, ring_attention,
                                                 ulysses_attention)
-        from mmlspark_tpu.ops.flash_attention import flash_attention
         b, s, _ = x.shape
         d_head = self.d_model // self.n_heads
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -218,6 +217,9 @@ class TransformerBlock(nn.Module):
         if self.attn_impl == "dense":
             o = attention(q, k, v, causal=True)
         elif self.attn_impl == "flash":
+            # import inside the branch: pallas is a slow import that
+            # dense/ring users must not pay
+            from mmlspark_tpu.ops.flash_attention import flash_attention
             o = flash_attention(q, k, v, causal=True)
         elif self.attn_impl == "ring":
             o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)

@@ -1,18 +1,17 @@
 """Batched device kernels (image ops, attention — dense, ring/Ulysses
-sequence-parallel, and the Pallas flash kernel)."""
+sequence-parallel, and the Pallas flash kernel).
+
+The flash kernel is NOT re-exported here: `mmlspark_tpu.ops.flash_attention`
+is the submodule (import the function from it), and importing it pulls
+jax.experimental.pallas + its TPU backend — a measurably slow import that
+dense/image-only users should never pay.  A lazy __getattr__ re-export
+would be permanently shadowed by the submodule object the first time
+anything imports it, resolving to a module or a function depending on
+process-wide import order.
+"""
 
 from mmlspark_tpu.ops import image
 from mmlspark_tpu.ops.attention import (attention, ring_attention,
                                         ulysses_attention)
 
-__all__ = ["image", "attention", "ring_attention", "ulysses_attention",
-           "flash_attention"]
-
-
-def __getattr__(name):
-    # flash_attention pulls jax.experimental.pallas (+ its TPU backend),
-    # a measurably slow import — load it only when asked for
-    if name == "flash_attention":
-        from mmlspark_tpu.ops.flash_attention import flash_attention
-        return flash_attention
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+__all__ = ["image", "attention", "ring_attention", "ulysses_attention"]

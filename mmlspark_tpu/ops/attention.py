@@ -126,6 +126,62 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None,
+                         block_q: int = 1024,
+                         block_k: int = 1024) -> jax.Array:
+    """Ring attention with the Pallas flash kernel as the local block op
+    (the published Ring Attention design): K/V shards rotate around the
+    mesh axis while each device runs `flash_attention_with_lse` against
+    the currently-held shard and merges the normalized partial outputs by
+    their log-sum-exp residuals.  Peak memory is O(block_q x block_k) per
+    core — both the sequence AND the per-device shard can exceed VMEM-era
+    limits (plain `ring_attention` materializes S_local x S_local scores
+    per fold).
+
+    Forward-only (the flash-with-lse kernel defines no VJP): this is the
+    scoring/long-context-inference path; training uses `ring_attention`.
+    Call under shard_map with `axis_name` in scope.
+    """
+    from mmlspark_tpu.ops.flash_attention import flash_attention_with_lse
+
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale_ = scale if scale is not None else d ** -0.5
+    q_off = my_idx * s_local
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    acc0 = (q * 0).astype(jnp.float32)                        # (B,S,H,D)
+    lse0 = (q[..., 0] * 0).astype(jnp.float32) + NEG_INF      # (B,S,H)
+
+    def fold(i, k_cur, v_cur, acc, lse):
+        src = (my_idx - i) % axis_size
+        o_i, lse_i = flash_attention_with_lse(
+            q, k_cur, v_cur, causal=causal, scale=scale_,
+            q_offset=q_off, k_offset=src * s_local,
+            block_q=block_q, block_k=block_k)
+        new_lse = jnp.logaddexp(lse, lse_i)
+        w_old = jnp.where(lse <= NEG_INF, 0.0, jnp.exp(lse - new_lse))
+        w_new = jnp.where(lse_i <= NEG_INF, 0.0, jnp.exp(lse_i - new_lse))
+        acc = acc * w_old[..., None] + o_i.astype(jnp.float32) \
+            * w_new[..., None]
+        return acc, new_lse
+
+    def step(i, carry):
+        k_cur, v_cur, acc, lse = carry
+        acc, lse = fold(i, k_cur, v_cur, acc, lse)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, acc, lse
+
+    k_last, v_last, acc, lse = jax.lax.fori_loop(
+        0, axis_size - 1, step, (k, v, acc0, lse0))
+    acc, _ = fold(axis_size - 1, k_last, v_last, acc, lse)
+    return acc.astype(q.dtype)
+
+
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str, causal: bool = False,
                       scale: Optional[float] = None) -> jax.Array:

@@ -33,8 +33,9 @@ from jax.experimental.pallas import tpu as pltpu
 from mmlspark_tpu.ops.attention import NEG_INF, attention
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool, block_q: int, block_k: int):
+def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                  m_ref, l_ref, *, scale: float, causal: bool, block_q: int,
+                  block_k: int, lse_ref=None):
     """One (batch*head, q-block, k-block) grid step.
 
     The grid's innermost dimension walks the K/V blocks; the online-softmax
@@ -46,6 +47,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     qi = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
+    q_off = qoff_ref[0]   # global position offsets (ring attention calls
+    k_off = koff_ref[0]   # with rotating K/V shard origins; 0 standalone)
 
     @pl.when(j == 0)
     def _():
@@ -53,8 +56,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: K/V blocks entirely above the diagonal contribute nothing
-    live = (j * block_k <= (qi + 1) * block_q - 1) if causal else j >= 0
+    if causal:
+        # K/V blocks entirely above the diagonal contribute nothing
+        live = (k_off + j * block_k) <= (q_off + (qi + 1) * block_q - 1)
+    else:
+        live = j >= 0
 
     @pl.when(live)
     def _():
@@ -64,9 +70,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
+            rows = q_off + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
+            cols = k_off + j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         m = m_ref[:][:, :1]                               # (block_q, 1)
@@ -87,41 +93,76 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(j == nk - 1)
     def _():
+        m = m_ref[:][:, :1]
         l = l_ref[:][:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # log-sum-exp of the scaled scores: the residual that lets a
+            # caller (ring attention) merge normalized partial outputs
+            lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+            lse_ref[0, :] = lse[:, 0]
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
-                   block_k: int, interpret: bool) -> jax.Array:
+                   block_k: int, interpret: bool, with_lse: bool = False,
+                   q_offset=0, k_offset=0):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head)
     q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     v3 = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
 
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
-    out = pl.pallas_call(
+    # under shard_map (ring attention) outputs must declare which mesh axes
+    # they vary over; inherit the query's varying-manual-axes type
+    vma = getattr(jax.typeof(q), "vma", None)
+    sds = (functools.partial(jax.ShapeDtypeStruct, vma=vma)
+           if vma else jax.ShapeDtypeStruct)
+    out_shapes = [sds((b * h, sq, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0))]
+    if with_lse:
+        # lse blocks are rank-2 (1, block_q): on real TPU block_q must be a
+        # lane multiple (128); interpret mode has no such constraint
+        out_shapes.append(sds((b * h, sq), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q),
+                                      lambda bh, qi, j: (bh, qi)))
+
+    def kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, *rest):
+        if with_lse:
+            lse_ref, acc_ref, m_ref, l_ref = rest
+        else:
+            (acc_ref, m_ref, l_ref), lse_ref = rest, None
+        _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref,
+                      acc_ref, m_ref, l_ref, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, lse_ref=lse_ref)
+
+    results = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda bh, qi, j: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, j: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shapes if with_lse else out_shapes[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),    # acc
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max (lane-bcast)
             pltpu.VMEM((block_q, 128), jnp.float32),  # normalizer (lane-bcast)
         ],
         interpret=interpret,
-    )(q3, k3, v3)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    )(qoff, koff, q3, k3, v3)
+    if with_lse:
+        out, lse = results
+        return (out.reshape(b, h, sq, d).transpose(0, 2, 1, 3),
+                lse.reshape(b, h, sq).transpose(0, 2, 1))
+    return results.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -147,6 +188,66 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _auto_interpret() -> bool:
+    # interpreter off only on real TPU compute (the `axon` tunneled
+    # platform reports device_kind "TPU v5 ..." with its own backend
+    # name, so match the device kind, not the backend string)
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    return "tpu" not in kind.lower()
+
+
+def _dense_with_lse(q, k, v, causal, scale, q_offset, k_offset):
+    """Reference-shape fallback: dense attention that also returns the
+    scaled-score log-sum-exp per query (f32), with global-position causal
+    masking."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(s.shape[-2])
+        k_pos = k_offset + jnp.arange(s.shape[-1])
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+    m = s.max(axis=-1)
+    safe_m = jnp.where(m == NEG_INF, 0.0, m)
+    p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - safe_m[..., None]))
+    l = p.sum(axis=-1)
+    lse = jnp.where(l == 0.0, NEG_INF, safe_m + jnp.log(jnp.maximum(l, 1e-30)))
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(l, 1e-30)[..., None],
+                     v.astype(jnp.float32))
+    return out.astype(q.dtype), lse.transpose(0, 2, 1)  # lse: (B, Sq, H)
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = False,
+                             scale: Optional[float] = None,
+                             q_offset=0, k_offset=0,
+                             block_q: int = 1024, block_k: int = 1024,
+                             interpret: Optional[bool] = None):
+    """Flash attention that ALSO returns the log-sum-exp residual
+    (B, Sq, H) — the merge key for combining normalized partial outputs
+    across K/V shards (ring_flash_attention).  `q_offset`/`k_offset` shift
+    global positions for causal masking when q / k are shards of a longer
+    sequence.  Forward-only (no VJP): the scoring/inference path.
+
+    On real TPU, block_q must be a lane multiple (128) for the rank-2 lse
+    output; non-tiling shapes fall back to the dense computation."""
+    d = q.shape[-1]
+    scale_ = scale if scale is not None else d ** -0.5
+    sq, sk = q.shape[1], k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if interpret is None:
+        interpret = _auto_interpret()
+    # inside shard_map (ring attention) the pallas INTERPRETER trips on
+    # varying-manual-axes bookkeeping; the dense local op is equivalent
+    # there (CPU test meshes) while real TPU compiles the kernel
+    in_manual_region = bool(getattr(jax.typeof(q), "vma", None))
+    if sq % block_q or sk % block_k or (not interpret and block_q % 128) \
+            or (interpret and in_manual_region):
+        return _dense_with_lse(q, k, v, causal, scale_, q_offset, k_offset)
+    return _flash_forward(q, k, v, causal, scale_, block_q, block_k,
+                          interpret, with_lse=True,
+                          q_offset=q_offset, k_offset=k_offset)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, scale: Optional[float] = None,
                     block_q: int = 1024, block_k: int = 1024,
@@ -168,9 +269,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sq % block_q or sk % block_k:
         return attention(q, k, v, causal=causal, scale=scale_)
     if interpret is None:
-        # interpreter off only on real TPU compute (the `axon` tunneled
-        # platform reports device_kind "TPU v5 ..." with its own backend
-        # name, so match the device kind, not the backend string)
-        kind = getattr(jax.devices()[0], "device_kind", "")
-        interpret = "tpu" not in kind.lower()
+        interpret = _auto_interpret()
     return _flash(q, k, v, causal, scale_, block_q, block_k, interpret)

@@ -45,6 +45,10 @@ def seq_parallel_attention(mesh: Mesh, q, k, v, causal: bool = False,
     if impl == "ring":
         fn = functools.partial(ring_attention, axis_name=seq_axis,
                                causal=causal)
+    elif impl == "ring_flash":
+        from mmlspark_tpu.ops.attention import ring_flash_attention
+        fn = functools.partial(ring_flash_attention, axis_name=seq_axis,
+                               causal=causal)
     elif impl == "ulysses":
         fn = functools.partial(ulysses_attention, axis_name=seq_axis,
                                causal=causal)

@@ -86,6 +86,46 @@ def test_gradients_match_dense():
                dict(rtol=1e-4, atol=1e-5)))
 
 
+def test_with_lse_matches_dense_stats():
+    """flash_attention_with_lse: output equals dense attention AND the lse
+    residual equals the scaled-score logsumexp (the ring merge key)."""
+    from mmlspark_tpu.ops.flash_attention import flash_attention_with_lse
+    q, k, v = _qkv(s=256, d=32)
+    out, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                        block_q=64, block_k=64)
+    ref = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * d ** -0.5
+    mask = jnp.tril(jnp.ones((256, 256), bool))
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    ref_lse = jax.scipy.special.logsumexp(s, axis=-1).transpose(0, 2, 1)
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(ref_lse),
+        **(dict(rtol=1e-2, atol=1e-2) if ON_TPU else
+           dict(rtol=1e-5, atol=1e-5)))
+
+
+def test_with_lse_offsets_mask_globally():
+    """q_offset/k_offset shift the causal mask by global positions: with
+    the k shard entirely AFTER the q shard, everything is masked (zero
+    output, -inf-class lse); entirely BEFORE, nothing is."""
+    from mmlspark_tpu.ops.attention import NEG_INF
+    from mmlspark_tpu.ops.flash_attention import flash_attention_with_lse
+    q, k, v = _qkv(s=64, d=16)
+    out, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                        q_offset=0, k_offset=64,
+                                        block_q=64, block_k=64)
+    assert np.allclose(np.asarray(out), 0.0)
+    assert np.all(np.asarray(lse) <= NEG_INF / 2)
+    out2, lse2 = flash_attention_with_lse(q, k, v, causal=True,
+                                          q_offset=64, k_offset=0,
+                                          block_q=64, block_k=64)
+    ref2 = attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2), **TOL)
+    assert np.all(np.isfinite(np.asarray(lse2)))
+
+
 def test_transformer_lm_flash_matches_dense():
     from mmlspark_tpu.models.definitions import build_model
     cfg = {"vocab_size": 64, "d_model": 64, "n_heads": 4, "n_layers": 2,

@@ -42,7 +42,7 @@ def test_dense_attention_causal(qkv):
     assert not np.allclose(out[:, -1], out2[:, -1])
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses", "dense"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses", "dense"])
 @pytest.mark.parametrize("causal", [False, True])
 def test_seq_parallel_matches_dense(qkv, seq_mesh, impl, causal):
     q, k, v = qkv

@@ -16,14 +16,19 @@ vs_baseline is measured-per-chip / 2000.
 Output: one JSON line per metric, HEADLINE LAST (drivers that parse a single
 line read the last one):
 
-  1. resnet50_224 — the MXU-bound workload (ImageFeaturizerSuite.scala:45-53
+  1. train_classifier_adult_census — notebook-101 TrainClassifier rows/sec
+     (BASELINE.json tracked config; host featurization + jitted fit, so no
+     link probe rides this line — it is not transfer-bound).
+  2. resnet50_224 — the MXU-bound workload (ImageFeaturizerSuite.scala:45-53
      class): end-to-end images/sec/chip plus `device_images_per_sec` /
      `device_mfu` for the HBM-resident steady state (what the chip itself
      sustains once the transfer link is out of the picture).
-  2. cifar10_convnet — the headline notebook-301 metric, best-of-3 reps
+  3. cifar10_convnet — the headline notebook-301 metric, best-of-N reps
      (tunneled-link variance burned round 2: 8442 -> 4852 img/s with
      byte-identical code), with an `mfu` field.
 
+Lines 2 and 3 carry a link-bandwidth probe taken adjacent to their
+measurement so throughput swings are attributable to link weather vs code.
 `--smoke` shrinks every size for CI schema checks (seconds, any backend).
 """
 

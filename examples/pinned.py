@@ -1,0 +1,50 @@
+"""Pinned-metric contract for the example workloads.
+
+The learner grid pins exact metrics (tests/benchmark_metrics.csv); the
+examples historically only asserted loose thresholds, so a silent quality
+drift inside any example's model/featurization stayed invisible (round-2
+verdict weak #5).  Each extractor below reduces an example's main() result
+to the scalar metrics worth pinning; scripts/regen_examples.py writes them
+to tests/example_metrics.json and tests/test_examples.py exact-diffs
+against it (regenerate DELIBERATELY, review the diff, commit).
+"""
+
+from __future__ import annotations
+
+_R = 4  # pinned decimal places: enough to catch drift, robust to fp noise
+
+
+def _r(v) -> float:
+    return round(float(v), _R)
+
+
+PIN_EXTRACTORS = {
+    "example_101_adult_census.py": lambda out: {
+        **{f"accuracy_{k}": _r(v) for k, v in out["accuracies"].items()},
+        "best_accuracy": _r(out["best_metrics"]["accuracy"]),
+    },
+    "example_102_flight_delays.py": lambda out: {
+        f"r2_{k}": _r(m["R^2"]) for k, m in out["metrics"].items()
+    },
+    "example_103_before_and_after.py": lambda out: {
+        "manual_accuracy": _r(out["manual_accuracy"]),
+        "auto_accuracy": _r(out["auto_accuracy"]),
+    },
+    "example_201_text_featurizer.py": lambda out: {
+        "accuracy": _r(out["accuracy"]), "AUC": _r(out["AUC"]),
+    },
+    "example_202_word2vec.py": lambda out: {
+        "accuracy": _r(out["accuracy"]), "n_vocab": int(out["n_vocab"]),
+    },
+    "example_301_cifar_eval.py": lambda out: {
+        "accuracy": _r(out["accuracy"]),
+    },
+    "example_302_image_pipeline.py": lambda out: {
+        "accuracy": _r(out["accuracy"]),
+        "feature_dim": int(out["feature_dim"]),
+    },
+}
+
+
+def collect(name: str, out: dict) -> dict:
+    return PIN_EXTRACTORS[name](out)

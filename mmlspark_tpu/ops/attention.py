@@ -58,6 +58,24 @@ def _block_scores(q, k, scale):
     return jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
 
 
+def _ring_fold_loop(k, v, axis_name: str, axis_size, fold, accumulators):
+    """The ring rotate/fold protocol shared by ring_attention and
+    ring_flash_attention: axis_size-1 fold+rotate steps, then a final fold
+    with no trailing ppermute (the last rotation's result would never be
+    read — wasted ICI hops).  `fold(i, k_cur, v_cur, *accs) -> accs`."""
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(i, carry):
+        k_cur, v_cur = carry[0], carry[1]
+        accs = fold(i, k_cur, v_cur, *carry[2:])
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, *accs)
+
+    carry = jax.lax.fori_loop(0, axis_size - 1, step, (k, v, *accumulators))
+    return fold(axis_size - 1, carry[0], carry[1], *carry[2:])
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str, causal: bool = False,
                    scale: Optional[float] = None) -> jax.Array:
@@ -85,7 +103,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     zero_bhs = (q[..., 0] * 0).astype(jnp.float32).transpose(0, 2, 1)
     m0 = zero_bhs + NEG_INF                                  # (B,H,Sq)
     l0 = zero_bhs
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def fold(i, k_cur, v_cur, acc, m, l):
         """Fold one K/V block into the online-softmax accumulators."""
@@ -109,18 +126,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
         return acc_new, m_new, l_new
 
-    def step(i, carry):
-        k_cur, v_cur, acc, m, l = carry
-        acc, m, l = fold(i, k_cur, v_cur, acc, m, l)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, acc, m, l
-
-    # N-1 fold+rotate steps, then a final fold with no trailing ppermute
-    # (the last rotation's result would never be read — wasted ICI hops)
-    k_last, v_last, acc, m, l = jax.lax.fori_loop(
-        0, axis_size - 1, step, (k, v, acc0, m0, l0))
-    acc, _, l = fold(axis_size - 1, k_last, v_last, acc, m, l)
+    acc, _, l = _ring_fold_loop(k, v, axis_name, axis_size, fold,
+                                (acc0, m0, l0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = acc / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
@@ -151,7 +158,6 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, s_local, h, d = q.shape
     scale_ = scale if scale is not None else d ** -0.5
     q_off = my_idx * s_local
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     acc0 = (q * 0).astype(jnp.float32)                        # (B,S,H,D)
     lse0 = (q[..., 0] * 0).astype(jnp.float32) + NEG_INF      # (B,S,H)
@@ -169,16 +175,7 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             * w_new[..., None]
         return acc, new_lse
 
-    def step(i, carry):
-        k_cur, v_cur, acc, lse = carry
-        acc, lse = fold(i, k_cur, v_cur, acc, lse)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, acc, lse
-
-    k_last, v_last, acc, lse = jax.lax.fori_loop(
-        0, axis_size - 1, step, (k, v, acc0, lse0))
-    acc, _ = fold(axis_size - 1, k_last, v_last, acc, lse)
+    acc, _ = _ring_fold_loop(k, v, axis_name, axis_size, fold, (acc0, lse0))
     return acc.astype(q.dtype)
 
 

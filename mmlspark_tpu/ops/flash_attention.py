@@ -188,6 +188,21 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _warn_dense_fallback(fn_name: str, sq: int, sk: int, block_q: int,
+                         block_k: int, interpret: bool) -> None:
+    """The dense fallback is O(Sq x Sk) memory — silent on a long-context
+    shard it is exactly the blow-up the flash path exists to avoid, so it
+    must be visible.  Fires at trace time (once per shape), real-compute
+    paths only (the interpreter already implies a test/CPU context)."""
+    if not interpret:
+        from mmlspark_tpu.observe import get_logger
+        get_logger("ops.flash").warning(
+            "%s: shapes (Sq=%d, Sk=%d) do not tile blocks (%d, %d) — "
+            "falling back to DENSE attention (O(Sq*Sk) memory); pad the "
+            "sequence or adjust block sizes to keep the flash path",
+            fn_name, sq, sk, block_q, block_k)
+
+
 def _auto_interpret() -> bool:
     # interpreter off only on real TPU compute (the `axon` tunneled
     # platform reports device_kind "TPU v5 ..." with its own backend
@@ -240,8 +255,11 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     # varying-manual-axes bookkeeping; the dense local op is equivalent
     # there (CPU test meshes) while real TPU compiles the kernel
     in_manual_region = bool(getattr(jax.typeof(q), "vma", None))
-    if sq % block_q or sk % block_k or (not interpret and block_q % 128) \
-            or (interpret and in_manual_region):
+    if sq % block_q or sk % block_k or (not interpret and block_q % 128):
+        _warn_dense_fallback("flash_attention_with_lse", sq, sk,
+                             block_q, block_k, interpret)
+        return _dense_with_lse(q, k, v, causal, scale_, q_offset, k_offset)
+    if interpret and in_manual_region:
         return _dense_with_lse(q, k, v, causal, scale_, q_offset, k_offset)
     return _flash_forward(q, k, v, causal, scale_, block_q, block_k,
                           interpret, with_lse=True,
@@ -266,8 +284,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     sq, sk = q.shape[1], k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        return attention(q, k, v, causal=causal, scale=scale_)
     if interpret is None:
         interpret = _auto_interpret()
+    if sq % block_q or sk % block_k:
+        _warn_dense_fallback("flash_attention", sq, sk, block_q, block_k,
+                             interpret)
+        return attention(q, k, v, causal=causal, scale=scale_)
     return _flash(q, k, v, causal, scale_, block_q, block_k, interpret)

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Deliberately regenerate the committed example-metric pins
+(tests/example_metrics.json).  Run after a change that legitimately moves
+an example's numbers, review the diff, and commit it — the counterpart of
+scripts/regen_benchmarks.py for the notebook-parity workloads."""
+
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_ENABLE_X64"] = "0"  # pins are float32, like the CI mesh
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+
+def main():
+    sys.path.insert(0, os.path.join(ROOT, "examples"))
+    from pinned import PIN_EXTRACTORS, collect
+
+    pins = {}
+    for name in sorted(PIN_EXTRACTORS):
+        path = os.path.join(ROOT, "examples", name)
+        spec = importlib.util.spec_from_file_location(name[:-3], path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        pins[name] = collect(name, mod.main(verbose=False))
+        print(f"{name}: {pins[name]}")
+
+    out = os.path.join(ROOT, "tests", "example_metrics.json")
+    with open(out, "w") as f:
+        json.dump(pins, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {os.path.normpath(out)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,7 @@ notebook runs under the test suite; here each example module's main() runs
 in-process with thresholds asserted)."""
 
 import importlib.util
+import json
 import os
 
 import pytest
@@ -12,12 +13,29 @@ EXAMPLES_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
 
 
-def _run(name: str) -> dict:
-    path = os.path.join(EXAMPLES_DIR, name)
-    spec = importlib.util.spec_from_file_location(name[:-3], path)
+def _load(path: str):
+    spec = importlib.util.spec_from_file_location(
+        os.path.basename(path)[:-3], path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod.main(verbose=False)
+    return mod
+
+
+def _run(name: str) -> dict:
+    out = _load(os.path.join(EXAMPLES_DIR, name)).main(verbose=False)
+    # committed-metric exact diff (the grid-CSV discipline applied to the
+    # notebook workloads; regenerate DELIBERATELY via
+    # scripts/regen_examples.py when a change legitimately moves numbers)
+    pinned = _load(os.path.join(EXAMPLES_DIR, "pinned.py"))
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "example_metrics.json")) as f:
+        committed = json.load(f)
+    got = pinned.collect(name, out)
+    assert got == committed[name], (
+        f"{name} metrics drifted from tests/example_metrics.json "
+        f"(regenerate deliberately if intended):\n  committed: "
+        f"{committed[name]}\n  got:       {got}")
+    return out
 
 
 @pytest.mark.slow

@@ -145,6 +145,12 @@ def bench_convnet(smoke: bool) -> dict:
         "mfu": round(m, 5) if (m := mfu(images_per_sec, fpi)) is not None else None,
         "device_images_per_sec": round(dev_ips, 1),
         "device_mfu": round(m, 4) if (m := mfu(dev_ips, fpi)) is not None else None,
+        # the 4x-K80 baseline assumed a LOCALLY-attached host (PCIe); over
+        # the tunneled bench link, `value` rides link weather (see link_*
+        # fields) while the HBM-resident rate is what a local host
+        # approaches — report its baseline ratio for attribution
+        "vs_baseline_device": round(dev_ips / TARGET_IMAGES_PER_SEC_PER_CHIP,
+                                    3),
         "reps": reps,
     }
 
@@ -209,6 +215,10 @@ def bench_train_classifier(smoke: bool) -> dict:
 
     n = 2000 if smoke else 20000
     table = adult_census_like(n=n, seed=0)
+    # untimed warmup fit at FULL shape: the jit cache is shape-keyed, so
+    # only a same-shaped fit moves remote-compile latency (harness, not
+    # framework) out of the timed region
+    TrainClassifier(LogisticRegression(), labelCol="income").fit(table)
     t0 = time.perf_counter()
     model = TrainClassifier(LogisticRegression(), labelCol="income").fit(table)
     wall = time.perf_counter() - t0

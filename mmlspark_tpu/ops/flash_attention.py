@@ -188,19 +188,26 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+_warned_fallbacks: set = set()
+
+
 def _warn_dense_fallback(fn_name: str, sq: int, sk: int, block_q: int,
-                         block_k: int, interpret: bool) -> None:
+                         block_k: int, interpret: bool,
+                         reason: str) -> None:
     """The dense fallback is O(Sq x Sk) memory — silent on a long-context
     shard it is exactly the blow-up the flash path exists to avoid, so it
-    must be visible.  Fires at trace time (once per shape), real-compute
-    paths only (the interpreter already implies a test/CPU context)."""
-    if not interpret:
-        from mmlspark_tpu.observe import get_logger
-        get_logger("ops.flash").warning(
-            "%s: shapes (Sq=%d, Sk=%d) do not tile blocks (%d, %d) — "
-            "falling back to DENSE attention (O(Sq*Sk) memory); pad the "
-            "sequence or adjust block sizes to keep the flash path",
-            fn_name, sq, sk, block_q, block_k)
+    must be visible.  Deduped per (fn, shape, reason) — eager per-batch
+    scoring loops must not spam; real-compute paths only (the interpreter
+    already implies a test/CPU context)."""
+    key = (fn_name, sq, sk, block_q, block_k, reason)
+    if interpret or key in _warned_fallbacks:
+        return
+    _warned_fallbacks.add(key)
+    from mmlspark_tpu.observe import get_logger
+    get_logger("ops.flash").warning(
+        "%s (Sq=%d, Sk=%d, blocks %d x %d): %s — falling back to DENSE "
+        "attention (O(Sq*Sk) memory)",
+        fn_name, sq, sk, block_q, block_k, reason)
 
 
 def _auto_interpret() -> bool:
@@ -255,9 +262,16 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     # varying-manual-axes bookkeeping; the dense local op is equivalent
     # there (CPU test meshes) while real TPU compiles the kernel
     in_manual_region = bool(getattr(jax.typeof(q), "vma", None))
-    if sq % block_q or sk % block_k or (not interpret and block_q % 128):
-        _warn_dense_fallback("flash_attention_with_lse", sq, sk,
-                             block_q, block_k, interpret)
+    if sq % block_q or sk % block_k:
+        _warn_dense_fallback(
+            "flash_attention_with_lse", sq, sk, block_q, block_k, interpret,
+            "sequence lengths do not tile the blocks (pad the sequence or "
+            "adjust block sizes)")
+        return _dense_with_lse(q, k, v, causal, scale_, q_offset, k_offset)
+    if not interpret and block_q % 128:
+        _warn_dense_fallback(
+            "flash_attention_with_lse", sq, sk, block_q, block_k, interpret,
+            "the lse output needs a lane-multiple block_q (128) on TPU")
         return _dense_with_lse(q, k, v, causal, scale_, q_offset, k_offset)
     if interpret and in_manual_region:
         return _dense_with_lse(q, k, v, causal, scale_, q_offset, k_offset)
@@ -287,7 +301,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if interpret is None:
         interpret = _auto_interpret()
     if sq % block_q or sk % block_k:
-        _warn_dense_fallback("flash_attention", sq, sk, block_q, block_k,
-                             interpret)
+        _warn_dense_fallback(
+            "flash_attention", sq, sk, block_q, block_k, interpret,
+            "sequence lengths do not tile the blocks (pad the sequence or "
+            "adjust block sizes)")
         return attention(q, k, v, causal=causal, scale=scale_)
     return _flash(q, k, v, causal, scale_, block_q, block_k, interpret)

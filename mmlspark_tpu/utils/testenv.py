@@ -1,0 +1,29 @@
+"""The ONE definition of the virtual CPU test mesh environment.
+
+tests/conftest.py, scripts/regen_benchmarks.py, and scripts/regen_examples.py
+must all compute on byte-identical backends or the committed pins (grid CSV,
+example metrics) silently diverge from what CI verifies.  Call BEFORE jax
+creates a backend (env vars alone are too late when sitecustomize imports
+jax at interpreter startup — the jax.config updates handle that)."""
+
+from __future__ import annotations
+
+import os
+
+VIRTUAL_DEVICES = 8
+
+
+def pin_virtual_cpu_mesh() -> None:
+    """Force the 8-virtual-device float32 CPU mesh (the local[*] analogue,
+    reference SparkSessionFactory.scala:40-51)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_ENABLE_X64"] = "0"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={VIRTUAL_DEVICES}"
+        ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", False)

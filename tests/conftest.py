@@ -14,20 +14,14 @@ import os
 # registry) can be imported; the var is still declared in mmlspark_tpu.config.
 _platform = os.environ.get("MMLSPARK_TPU_TEST_PLATFORM", "cpu")
 if _platform == "cpu":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+    # the ONE mesh definition shared with the pin-regeneration scripts —
+    # committed pins are only valid when all of them compute identically
+    from mmlspark_tpu.utils.testenv import pin_virtual_cpu_mesh
+    pin_virtual_cpu_mesh()
+else:
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-# The environment's sitecustomize may import jax at interpreter startup
-# (registering a TPU PJRT plugin), which makes env vars alone too late;
-# jax.config can still flip the platform before any backend initializes.
-import jax
-
-if _platform == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+import jax  # noqa: F401  (backend must initialize after the pinning above)
 
 import numpy as np
 import pytest

@@ -20,7 +20,20 @@ from typing import Iterator
 from mmlspark_tpu.core.schema import ColumnMeta, ImageSchema
 from mmlspark_tpu.core.table import DataTable, object_column
 from mmlspark_tpu.io.files import iter_binary_files, read_binary_files
-from mmlspark_tpu.native_loader import native_decode
+from mmlspark_tpu.native_loader import native_decode, native_decode_batch
+
+
+def _pil_decode(data: bytes) -> Optional[np.ndarray]:
+    try:
+        import io
+        from PIL import Image
+        img = Image.open(io.BytesIO(data))
+        arr = np.asarray(img.convert("L" if img.mode == "L" else "RGB"))
+        if arr.ndim == 2:
+            return arr[:, :, None]
+        return arr[:, :, ::-1].copy()  # RGB -> BGR
+    except Exception:
+        return None
 
 
 def decode_bytes(data: bytes) -> Optional[np.ndarray]:
@@ -32,16 +45,7 @@ def decode_bytes(data: bytes) -> Optional[np.ndarray]:
     out = native_decode(data)
     if out is not None:
         return out
-    try:
-        import io
-        from PIL import Image
-        img = Image.open(io.BytesIO(data))
-        arr = np.asarray(img.convert("L" if img.mode == "L" else "RGB"))
-        if arr.ndim == 2:
-            return arr[:, :, None]
-        return arr[:, :, ::-1].copy()  # RGB -> BGR
-    except Exception:
-        return None
+    return _pil_decode(data)
 
 
 def _resize_all(images: list, resize_to: tuple) -> list:
@@ -68,6 +72,22 @@ def _resize_all(images: list, resize_to: tuple) -> list:
     return out
 
 
+def decode_many(buffers: list) -> list:
+    """Decode a batch of image buffers; None per undecodable entry.
+
+    The C++ thread-pool path (native_decode_batch) decodes the whole batch
+    in parallel outside the GIL — the data-loader hot path; entries it
+    can't handle (exotic formats, no native lib) retry through the
+    per-item `decode_bytes` PIL fallback."""
+    native = native_decode_batch(buffers)
+    if native is None:
+        return [decode_bytes(b) for b in buffers]
+    # the batch call already proved the None entries native-undecodable —
+    # retry them through PIL only, not through a second native probe
+    return [img if img is not None else _pil_decode(buffers[i])
+            for i, img in enumerate(native)]
+
+
 def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
                 inspect_zip: bool = True, resize_to: Optional[tuple] = None,
                 drop_failures: bool = True, pattern: Optional[str] = None,
@@ -88,8 +108,8 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
                               inspect_zip=inspect_zip, pattern=pattern,
                               seed=seed)
     paths, images = [], []
-    for p, data in zip(files["path"], files["bytes"]):
-        img = decode_bytes(data)
+    decoded = decode_many(list(files["bytes"]))
+    for p, img in zip(files["path"], decoded):
         if img is None:
             if drop_failures:
                 continue
@@ -131,9 +151,10 @@ def read_images_iter(path: str, batch_size: int = 256,
 
     The out-of-core face of `read_images` (reference streams partitions,
     BinaryFileReader.scala:28-69): yields (path, image) tables of at most
-    `batch_size` rows, decoding lazily — at any moment only one batch of
-    decoded pixels is resident, so corpus size is unbounded by host RAM.
-    Feed the result to `TPUModel.transform_batches` for streaming scoring.
+    `batch_size` rows, decoding batch-at-a-time (the parallel C++ decoder)
+    — peak residency is one batch of encoded buffers plus up to ~2 batches
+    of decoded pixels, so corpus size is unbounded by host RAM.  Feed the
+    result to `TPUModel.transform_batches` for streaming scoring.
 
     Every batch is dense (N, H, W, C) uint8: with resize_to=(H, W) decoded
     images are batch-resized on device to (H, W, 3) — the same
@@ -146,35 +167,50 @@ def read_images_iter(path: str, batch_size: int = 256,
         raise ValueError(f"batch_size must be positive, got {batch_size}")
     paths: list = []
     images: list = []
+    pend_paths: list = []
+    pend_bufs: list = []
     first_shape: Optional[tuple] = None
 
-    def flush() -> DataTable:
+    def decode_pending() -> None:
+        nonlocal first_shape
+        decoded = decode_many(pend_bufs)
+        for p, img in zip(pend_paths, decoded):
+            if img is None:
+                if drop_failures:
+                    continue
+                raise ValueError(f"could not decode image: {p}")
+            if resize_to is None:
+                if first_shape is None:
+                    first_shape = img.shape
+                elif img.shape != first_shape:
+                    raise ValueError(
+                        f"streaming without resize_to needs uniform shapes; "
+                        f"{p} is {img.shape}, stream started with "
+                        f"{first_shape}")
+            paths.append(p)
+            images.append(img)
+        pend_paths.clear()
+        pend_bufs.clear()
+
+    def flush(k: int) -> DataTable:
         nonlocal paths, images
-        table = _dense_batch(
-            paths, _resize_all(images, resize_to) if resize_to is not None
-            else images)
-        paths, images = [], []
-        return table
+        batch, keep = images[:k], images[k:]
+        batch_paths, paths = paths[:k], paths[k:]
+        images = keep
+        return _dense_batch(
+            batch_paths, _resize_all(batch, resize_to)
+            if resize_to is not None else batch)
 
     for p, data in iter_binary_files(path, recursive=recursive,
                                      sample_ratio=sample_ratio,
                                      inspect_zip=inspect_zip,
                                      pattern=pattern, seed=seed):
-        img = decode_bytes(data)
-        if img is None:
-            if drop_failures:
-                continue
-            raise ValueError(f"could not decode image: {p}")
-        if resize_to is None:
-            if first_shape is None:
-                first_shape = img.shape
-            elif img.shape != first_shape:
-                raise ValueError(
-                    f"streaming without resize_to needs uniform shapes; "
-                    f"{p} is {img.shape}, stream started with {first_shape}")
-        paths.append(p)
-        images.append(img)
-        if len(images) >= batch_size:
-            yield flush()
-    if images:
-        yield flush()
+        pend_paths.append(p)
+        pend_bufs.append(data)
+        if len(pend_bufs) >= batch_size:
+            decode_pending()  # one parallel C++ decode per batch
+            while len(images) >= batch_size:
+                yield flush(batch_size)
+    decode_pending()
+    while images:
+        yield flush(batch_size)

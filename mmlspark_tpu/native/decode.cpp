@@ -9,9 +9,12 @@
 // Exposed as a plain C ABI consumed via ctypes (the NativeLoader-equivalent
 // lives in mmlspark_tpu/native_loader.py, cf. NativeLoader.java:29-159).
 
+#include <atomic>
 #include <csetjmp>
 #include <cstdio>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 #include <jpeglib.h>
 #include <png.h>
@@ -151,6 +154,36 @@ int decode_image(const unsigned char* buf, long len, unsigned char* out,
     return 0;
   }
   return -2;
+}
+
+// Parallel batch decode: n independent buffers decoded by a thread pool
+// (libjpeg/libpng handles are per-call, so decodes are embarrassingly
+// parallel; the Python caller holds the GIL exactly once for the whole
+// batch instead of once per image).  outs[i] must be pre-allocated to
+// heights[i]*widths[i]*channels[i] bytes (probe with image_dims first).
+// status[i] receives each image's decode_image return code; the function
+// returns the number of failures.
+int decode_batch(const unsigned char** bufs, const long* lens,
+                 unsigned char** outs, const int* widths, const int* heights,
+                 const int* channels, int n, int n_threads, int* status) {
+  if (n <= 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n) n_threads = n;
+  std::atomic<int> next(0);
+  std::atomic<int> failures(0);
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      status[i] = decode_image(bufs[i], lens[i], outs[i], widths[i],
+                               heights[i], channels[i]);
+      if (status[i] != 0) failures.fetch_add(1);
+    }
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(static_cast<size_t>(n_threads) - 1);
+  for (int t = 1; t < n_threads; ++t) threads.emplace_back(worker);
+  worker();
+  for (auto& th : threads) th.join();
+  return failures.load();
 }
 
 }  // extern "C"

@@ -18,12 +18,13 @@ def pin_virtual_cpu_mesh() -> None:
     reference SparkSessionFactory.scala:40-51)."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["JAX_ENABLE_X64"] = "0"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags +
-            f" --xla_force_host_platform_device_count={VIRTUAL_DEVICES}"
-        ).strip()
+    # FORCE the device count: a leftover foreign
+    # --xla_force_host_platform_device_count (e.g. from multihost-worker
+    # experiments) must not leak into pin regeneration
+    kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={VIRTUAL_DEVICES}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", False)

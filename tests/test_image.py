@@ -206,3 +206,50 @@ def test_image_featurizer_cut_layers():
     named = ImageFeaturizer(bundle, inputCol="image", outputCol="p3",
                             layerName="pool3").transform(t)
     assert named["p3"].shape[0] == 6 and named["p3"].ndim == 4
+
+
+def test_decode_many_matches_per_item():
+    """The C++ thread-pool batch decode must match per-item decode exactly,
+    handle undecodable entries as None, and fall back to PIL for formats
+    the native decoder doesn't cover."""
+    import io
+
+    from PIL import Image
+
+    from mmlspark_tpu.io.image_reader import decode_bytes, decode_many
+    rng = np.random.default_rng(0)
+    bufs, kinds = [], []
+    for i in range(12):
+        arr = rng.integers(0, 256, (10 + i, 12, 3), dtype=np.uint8)
+        b = io.BytesIO()
+        fmt = ["PNG", "JPEG", "BMP"][i % 3]  # BMP: PIL-fallback-only format
+        Image.fromarray(arr).save(b, fmt)
+        bufs.append(b.getvalue())
+        kinds.append(fmt)
+    bufs.append(b"definitely not an image")
+    out = decode_many(bufs)
+    assert len(out) == 13
+    assert out[-1] is None
+    for buf, img, fmt in zip(bufs[:-1], out[:-1], kinds):
+        ref = decode_bytes(buf)
+        assert img is not None and np.array_equal(img, ref), fmt
+
+
+def test_native_decode_batch_threaded():
+    from mmlspark_tpu.native_loader import native_decode, native_decode_batch
+    if native_decode_batch([]) is None:
+        import pytest
+        pytest.skip("native decoder unavailable in this environment")
+    import io
+
+    from PIL import Image
+    rng = np.random.default_rng(1)
+    bufs = []
+    for i in range(64):  # enough to exercise the thread pool's work queue
+        arr = rng.integers(0, 256, (9, 9, 3), dtype=np.uint8)
+        b = io.BytesIO()
+        Image.fromarray(arr).save(b, "PNG")
+        bufs.append(b.getvalue())
+    out = native_decode_batch(bufs)
+    ref = [native_decode(b) for b in bufs]
+    assert all(np.array_equal(a, b) for a, b in zip(out, ref))

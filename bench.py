@@ -235,6 +235,81 @@ def bench_train_classifier(smoke: bool) -> dict:
     }
 
 
+def bench_lm_train(smoke: bool) -> dict:
+    """TransformerLM training throughput (tokens/sec/chip) with the Pallas
+    flash-attention forward: the long-context training workload class the
+    reference cannot express at all (it has no sequence dimension,
+    SURVEY §5).  Data is HBM-resident (standard for training benches);
+    MFU comes from XLA's own cost analysis of the compiled train step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mmlspark_tpu.models.definitions import build_model
+    from mmlspark_tpu.utils.perf import device_peak_flops
+
+    if smoke:
+        b, s, cfg = 2, 256, {"vocab_size": 256, "d_model": 64, "n_heads": 4,
+                             "n_layers": 2, "max_len": 256}
+        iters = 3
+    else:
+        b, s, cfg = 8, 2048, {"vocab_size": 8192, "d_model": 512,
+                              "n_heads": 8, "n_layers": 4, "max_len": 2048}
+        iters = 20
+    model = build_model("TransformerLM", {**cfg, "attn_impl": "flash"})
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg["vocab_size"], (b, s)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.key(0), tokens)
+    tx = optax.adam(3e-4)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, tokens, targets):
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            return -ll.mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    lowered = step.lower(params, opt_state, tokens, targets)
+    compiled = lowered.compile()
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        step_flops = float(cost.get("flops") or 0) or None
+    except Exception:
+        step_flops = None
+
+    params, opt_state, loss = step(params, opt_state, tokens, targets)  # warm
+    float(loss)  # scalar fetch: a REAL sync (block_until_ready can return
+    # early through tunneled backends and fabricate impossible rates)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    final_loss = float(loss)
+    elapsed = time.perf_counter() - t0
+    tokens_per_sec = iters * b * s / elapsed / len(jax.devices())
+    peak = device_peak_flops()
+    train_mfu = (step_flops * iters / elapsed / peak
+                 if step_flops and peak else None)
+    return {
+        "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # no reference LM-training workload exists
+        "mfu": round(train_mfu, 4) if train_mfu is not None else None,
+        "final_loss": round(final_loss, 4),
+        "seq_len": s,
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true",
@@ -242,6 +317,7 @@ def main():
     args = parser.parse_args()
 
     print(json.dumps(bench_train_classifier(args.smoke)))
+    print(json.dumps(bench_lm_train(args.smoke)), flush=True)
     # probe adjacent to each measurement — tunnel bandwidth swings over
     # minutes, and a stale probe would misattribute exactly the way the
     # probe exists to prevent

@@ -48,12 +48,15 @@ def register(name: str, default: Any = None, doc: str = "",
     """Declare a config variable (idempotent for identical declarations)."""
     if not name.startswith(_PREFIX):
         raise ValueError(f"config vars are namespaced {_PREFIX}*; got {name!r}")
-    var = ConfigVar(name, default, doc, ptype)
     existing = _registry.get(name)
-    if existing is not None and (existing.default, existing.doc) != \
-            (default, doc):
-        raise ValueError(f"{name} already registered with different "
-                         f"default/doc; one declaration per variable")
+    if existing is not None:
+        if (existing.default, existing.doc, existing.ptype) != \
+                (default, doc, ptype):
+            raise ValueError(f"{name} already registered with different "
+                             f"default/doc/ptype; one declaration per "
+                             f"variable")
+        return existing  # identical re-declaration: keep the one instance
+    var = ConfigVar(name, default, doc, ptype)
     _registry[name] = var
     return var
 

@@ -74,6 +74,19 @@ class TPUModel(Transformer):
             self._mesh = best_mesh()
         return self._mesh
 
+    @staticmethod
+    def _mesh_is_multiprocess(mesh) -> bool:
+        """Dispatch rule: the MESH decides the scoring topology, not
+        `jax.process_count()`.  A mesh spanning processes takes the lockstep
+        global path (`_transform_multihost`: every process dispatches the
+        same step count, collectives stay aligned); a local-devices mesh —
+        the `best_mesh()` default under multi-host — scores this process's
+        rows independently with the ordinary windowed loop, because scoring
+        over row partitions is embarrassingly parallel (the reference's
+        per-executor eval loop, CNTKModel.scala:215-221) and needs no
+        cross-host collectives or lockstep batching."""
+        return len({d.process_index for d in mesh.devices.flat}) > 1
+
     # -- forward construction ------------------------------------------
     def _select_output(self, final, intermediates: dict):
         name = self.outputNodeName
@@ -155,16 +168,17 @@ class TPUModel(Transformer):
         # CheckpointData may have pre-staged this column in device memory
         # (stages/basic.py); repeated passes then skip the host->HBM transfer.
         dev_col = getattr(table, "_device_cache", {}).get(in_col)
-        if dev_col is None and jax.process_count() == 1:
+        mesh, variables, apply_fn = self._device_state()
+        multiproc = self._mesh_is_multiprocess(mesh)
+        if dev_col is None and not multiproc:
             # ONE canonical pipelined dispatch loop (transform_batches):
             # a single table is a one-element stream.  Delegate BEFORE any
             # column conversion so the work isn't done twice.
             [scored] = list(self.transform_batches([table]))
             return scored
         col = self._tensor_column(table[in_col])
-        mesh, variables, apply_fn = self._device_state()
         bs = self._effective_batch_size(mesh)
-        if jax.process_count() > 1:
+        if multiproc:
             result = self._transform_multihost(col, mesh, variables,
                                                apply_fn, bs)
             return table.with_column(self.outputCol, result)
@@ -231,7 +245,7 @@ class TPUModel(Transformer):
             raise ValueError("TPUModel: inputCol is not set")
         mesh, variables, apply_fn = self._device_state()
         bs = self._effective_batch_size(mesh)
-        if jax.process_count() > 1:
+        if self._mesh_is_multiprocess(mesh):
             # per-table lockstep path (no cross-table window: every process
             # must agree on dispatch order)
             for table in tables:
@@ -307,6 +321,17 @@ class TPUModel(Transformer):
         from mmlspark_tpu.parallel.mesh import DATA_AXIS
 
         nproc = jax.process_count()
+        mesh_procs = {d.process_index for d in mesh.devices.flat}
+        if len(mesh_procs) != nproc:
+            # a mesh spanning a strict SUBSET of processes would make the
+            # cluster-wide allgather below (and put_sharded's global
+            # assembly) undefined for non-member processes — fail loudly
+            # rather than hang
+            raise ValueError(
+                f"multi-host scoring mesh spans {len(mesh_procs)} of "
+                f"{nproc} processes; use a mesh over ALL processes' "
+                f"devices, or a local-devices mesh for independent "
+                f"per-process scoring")
         n_data = mesh.shape[DATA_AXIS]
         if n_data % nproc:
             raise ValueError(

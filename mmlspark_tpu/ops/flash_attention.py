@@ -196,18 +196,26 @@ def _warn_dense_fallback(fn_name: str, sq: int, sk: int, block_q: int,
                          reason: str) -> None:
     """The dense fallback is O(Sq x Sk) memory — silent on a long-context
     shard it is exactly the blow-up the flash path exists to avoid, so it
-    must be visible.  Deduped per (fn, shape, reason) — eager per-batch
-    scoring loops must not spam; real-compute paths only (the interpreter
-    already implies a test/CPU context)."""
-    key = (fn_name, sq, sk, block_q, block_k, reason)
+    must be visible.  Deduped per (fn, reason) with the first-seen shape in
+    the message — a long-running scoring service cycling through many
+    distinct sequence lengths must neither re-warn per shape nor grow the
+    dedup set unboundedly; real-compute paths only (the interpreter already
+    implies a test/CPU context)."""
+    key = (fn_name, reason)
     if interpret or key in _warned_fallbacks:
         return
     _warned_fallbacks.add(key)
     from mmlspark_tpu.observe import get_logger
     get_logger("ops.flash").warning(
-        "%s (Sq=%d, Sk=%d, blocks %d x %d): %s — falling back to DENSE "
-        "attention (O(Sq*Sk) memory)",
+        "%s (first seen at Sq=%d, Sk=%d, blocks %d x %d): %s — falling "
+        "back to DENSE attention (O(Sq*Sk) memory); warned once per reason",
         fn_name, sq, sk, block_q, block_k, reason)
+
+
+def _in_manual_region(x) -> bool:
+    """True inside a shard_map manual region (the array type carries
+    varying-manual-axes); the pallas interpreter cannot run there."""
+    return bool(getattr(jax.typeof(x), "vma", None))
 
 
 def _auto_interpret() -> bool:
@@ -261,7 +269,7 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     # inside shard_map (ring attention) the pallas INTERPRETER trips on
     # varying-manual-axes bookkeeping; the dense local op is equivalent
     # there (CPU test meshes) while real TPU compiles the kernel
-    in_manual_region = bool(getattr(jax.typeof(q), "vma", None))
+    in_manual_region = _in_manual_region(q)
     if sq % block_q or sk % block_k:
         _warn_dense_fallback(
             "flash_attention_with_lse", sq, sk, block_q, block_k, interpret,
@@ -305,5 +313,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             "flash_attention", sq, sk, block_q, block_k, interpret,
             "sequence lengths do not tile the blocks (pad the sequence or "
             "adjust block sizes)")
+        return attention(q, k, v, causal=causal, scale=scale_)
+    # same guard as flash_attention_with_lse: inside shard_map the pallas
+    # INTERPRETER (CPU test meshes) trips on varying-manual-axes
+    # bookkeeping; the dense local op is equivalent there
+    if interpret and _in_manual_region(q):
         return attention(q, k, v, causal=causal, scale=scale_)
     return _flash(q, k, v, causal, scale_, block_q, block_k, interpret)

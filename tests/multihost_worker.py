@@ -76,8 +76,22 @@ def main():
     x_score = x_local[:-3] if pid == 0 else x_local
     scorer = TPUModel(bundle, inputCol="features", outputCol="scores",
                       miniBatchSize=32)
+    # default path: no set_mesh -> best_mesh() is LOCAL-devices-only under
+    # multi-host, so this process scores independently (windowed local loop,
+    # no lockstep)
+    assert not scorer._mesh_is_multiprocess(scorer._get_mesh())
     scored = scorer.transform(DataTable({"features": x_score}))
     assert scored["scores"].shape[0] == len(x_score), scored["scores"].shape
+
+    # explicit GLOBAL mesh: the lockstep _transform_multihost path; must
+    # produce the same rows for this process as the local-mesh default
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+    scorer_g = TPUModel(bundle, inputCol="features", outputCol="scores",
+                        miniBatchSize=32).set_mesh(make_mesh(MeshSpec()))
+    assert scorer_g._mesh_is_multiprocess(scorer_g._get_mesh())
+    scored_g = scorer_g.transform(DataTable({"features": x_score}))
+    np.testing.assert_allclose(scored_g["scores"], scored["scores"],
+                               rtol=1e-5, atol=1e-6)
 
     # unequal partitions (20 vs 12 rows): lockstep trains 12 rows/epoch but
     # the rotation must cycle every local row in within ceil(20/12)=2 epochs

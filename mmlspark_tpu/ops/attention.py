@@ -23,6 +23,7 @@ virtual 8-device mesh, tests/test_seq_parallel.py).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -133,24 +134,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
-def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                         axis_name: str, causal: bool = False,
-                         scale: Optional[float] = None,
-                         block_q: int = 1024,
-                         block_k: int = 1024) -> jax.Array:
-    """Ring attention with the Pallas flash kernel as the local block op
-    (the published Ring Attention design): K/V shards rotate around the
-    mesh axis while each device runs `flash_attention_with_lse` against
-    the currently-held shard and merges the normalized partial outputs by
-    their log-sum-exp residuals.  Peak memory is O(block_q x block_k) per
-    core — both the sequence AND the per-device shard can exceed VMEM-era
-    limits (plain `ring_attention` materializes S_local x S_local scores
-    per fold).
-
-    Forward-only (the flash-with-lse kernel defines no VJP): this is the
-    scoring/long-context-inference path; training uses `ring_attention`.
-    Call under shard_map with `axis_name` in scope.
-    """
+def _ring_flash_forward(q, k, v, axis_name, causal, scale, block_q, block_k):
+    """Ring flash forward: returns (out, lse) with lse the FULL-sequence
+    log-sum-exp per query (B, S_local, H) — the backward's global softmax
+    statistic."""
     from mmlspark_tpu.ops.flash_attention import flash_attention_with_lse
 
     axis_size = jax.lax.psum(1, axis_name)
@@ -175,8 +162,92 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             * w_new[..., None]
         return acc, new_lse
 
-    acc, _ = _ring_fold_loop(k, v, axis_name, axis_size, fold, (acc0, lse0))
-    return acc.astype(q.dtype)
+    acc, lse = _ring_fold_loop(k, v, axis_name, axis_size, fold,
+                               (acc0, lse0))
+    return acc.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None,
+                         block_q: int = 1024,
+                         block_k: int = 1024) -> jax.Array:
+    """Ring attention with the Pallas flash kernel as the local block op
+    (the published Ring Attention design): K/V shards rotate around the
+    mesh axis while each device runs `flash_attention_with_lse` against
+    the currently-held shard and merges the normalized partial outputs by
+    their log-sum-exp residuals.  Peak memory is O(block_q x block_k) per
+    core — both the sequence AND the per-device shard can exceed VMEM-era
+    limits (plain `ring_attention` materializes S_local x S_local scores
+    per fold).
+
+    Differentiable: the custom VJP runs a second ring pass in which each
+    dK/dV accumulator travels WITH its K/V shard (returning home after the
+    full cycle) while every device folds its local `flash_block_grads`
+    contribution against the forward's saved full-sequence LSE — the
+    long-context TRAINING path, still O(block_q x block_k) peak memory.
+    Call under shard_map with `axis_name` in scope.
+    """
+    out, _ = _ring_flash_forward(q, k, v, axis_name, causal, scale,
+                                 block_q, block_k)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
+    out, lse = _ring_flash_forward(q, k, v, axis_name, causal, scale,
+                                   block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, res, g):
+    from mmlspark_tpu.ops.flash_attention import flash_block_grads
+
+    q, k, v, out, lse = res
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale_ = scale if scale is not None else d ** -0.5
+    q_off = my_idx * s_local
+    # delta = rowsum(dO * O): global because O is the full-softmax output
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    dq0 = (q * 0).astype(jnp.float32)
+    dk0 = (k * 0).astype(jnp.float32)
+    dv0 = (v * 0).astype(jnp.float32)
+
+    rot = lambda x: jax.lax.ppermute(x, axis_name, perm)
+
+    def fold(i, k_cur, v_cur, dk_cur, dv_cur, dq):
+        src = (my_idx - i) % axis_size
+        dq_c, dk_c, dv_c = flash_block_grads(
+            q, k_cur, v_cur, g, lse, delta, causal, scale_,
+            q_offset=q_off, k_offset=src * s_local,
+            block_q=block_q, block_k=block_k)
+        return (dk_cur + dk_c.astype(jnp.float32),
+                dv_cur + dv_c.astype(jnp.float32),
+                dq + dq_c.astype(jnp.float32))
+
+    def step(i, carry):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        dk_cur, dv_cur, dq = fold(i, k_cur, v_cur, dk_cur, dv_cur, dq)
+        # dk/dv rotate WITH their k/v shard so each accumulated gradient
+        # ends on the device owning the shard it grades
+        return rot(k_cur), rot(v_cur), rot(dk_cur), rot(dv_cur), dq
+
+    k_l, v_l, dk_l, dv_l, dq_l = jax.lax.fori_loop(
+        0, axis_size - 1, step, (k, v, dk0, dv0, dq0))
+    # final fold outside the loop: k/v have made their last useful hop, so
+    # only dk/dv take one more ppermute home (the forward's
+    # _ring_fold_loop trims the same dead hops)
+    dk_l, dv_l, dq_fin = fold(axis_size - 1, k_l, v_l, dk_l, dv_l, dq_l)
+    dk_fin, dv_fin = rot(dk_l), rot(dv_l)
+    return (dq_fin.astype(q.dtype), dk_fin.astype(k.dtype),
+            dv_fin.astype(v.dtype))
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,

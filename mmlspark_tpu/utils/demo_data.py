@@ -195,3 +195,30 @@ def grid_datasets() -> dict[str, DataTable]:
         {**{f"f{i}": x[:, i] for i in range(d)}, "label": y.astype(np.float64)})
 
     return out
+
+
+def digits_images(seed: int = 0):
+    """REAL image-classification data: the UCI handwritten-digits set that
+    ships inside scikit-learn (1797 8x8 grayscale images, 10 classes) — the
+    one genuine labeled image dataset available to an air-gapped build
+    (CIFAR-10's raw archive needs network egress; see docs/design_cuts.md).
+    Images are nearest-neighbor upscaled to the ConvNetCIFAR10 input
+    contract (32, 32, 3) uint8 so the flagship scoring model trains and
+    scores on real data with real accuracy semantics (the reference's
+    equivalent fixture is its pretrained ConvNet_CIFAR10.model,
+    CNTKTestUtils.scala:12-36).
+
+    Returns (x_train, y_train, x_test, y_test): deterministic shuffled
+    80/20 split, images uint8 (N, 32, 32, 3), labels int32."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    imgs = d.images.astype(np.float32)                 # (N, 8, 8), 0..16
+    x = np.kron(imgs, np.ones((1, 4, 4), np.float32))  # -> (N, 32, 32)
+    x = np.clip(x * (255.0 / 16.0), 0, 255).astype(np.uint8)
+    x = np.repeat(x[..., None], 3, axis=-1)
+    y = d.target.astype(np.int32)
+    order = np.random.default_rng(seed).permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = len(x) // 5
+    return x[n_test:], y[n_test:], x[:n_test], y[:n_test]

@@ -1,0 +1,82 @@
+"""Train the flagship ConvNet on real data and publish it to the package zoo.
+
+Produces the repo's pretrained model artifact — the counterpart of the
+reference's CDN-hosted trained models (ModelDownloader.scala:109-157,
+ConvNet_CIFAR10.model in CNTKTestUtils.scala:12-36).  CIFAR-10's raw data
+needs network egress this build does not have, so the model trains on the
+REAL UCI handwritten-digits images shipped inside scikit-learn
+(utils/demo_data.py::digits_images) — trained weights, genuine held-out
+accuracy, semantically meaningful features (docs/design_cuts.md records the
+substitution).
+
+The entire flow is the framework's own: Trainer fits, TPUModel scores the
+held-out split, LocalRepo.add_model packs + hashes + writes the .meta, and
+the result is committed as package data under mmlspark_tpu/zoo/pretrained/
+so `pretrained_repo()` works from any install.
+
+Run (any backend; deterministic per backend, ~1 min on CPU):
+    python scripts/train_zoo_model.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PRETRAINED_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "mmlspark_tpu", "zoo", "pretrained")
+
+LAYER_NAMES = ["z", "dense1", "pool3", "pool2", "pool1"]
+
+
+def main():
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.models import TPUModel
+    from mmlspark_tpu.train import Trainer, TrainerConfig
+    from mmlspark_tpu.utils.demo_data import digits_images
+    from mmlspark_tpu.zoo import LocalRepo
+
+    x_train, y_train, x_test, y_test = digits_images()
+    print(f"train {x_train.shape} test {x_test.shape}")
+
+    trainer = Trainer(TrainerConfig(
+        architecture="ConvNetCIFAR10",
+        model_config={},
+        optimizer="adam", learning_rate=1e-3, lr_schedule="cosine",
+        epochs=30, batch_size=128, loss="softmax_xent", seed=0))
+    # uint8 -> float32 [0, 255]: the same contract TPUModel applies at
+    # scoring time (cast on device, no normalization)
+    bundle = trainer.fit_arrays(x_train.astype(np.float32), y_train)
+
+    def accuracy(x, y):
+        scored = TPUModel(bundle, inputCol="image", outputCol="scores",
+                          miniBatchSize=256).transform(
+            DataTable({"image": x}))
+        return float((np.argmax(scored["scores"], axis=1) == y).mean())
+
+    train_acc = accuracy(x_train, y_train)
+    test_acc = accuracy(x_test, y_test)
+    print(f"train accuracy {train_acc:.4f}  test accuracy {test_acc:.4f}")
+    assert test_acc >= 0.90, f"refusing to publish a weak model: {test_acc}"
+
+    bundle.metadata.update({
+        "input_shape": [1, 32, 32, 3],
+        "layer_names": LAYER_NAMES,
+        "pretrained": True,
+        "train_dataset": "UCI handwritten digits (sklearn load_digits), "
+                         "upscaled 8x8 -> 32x32x3",
+        "train_accuracy": round(train_acc, 4),
+        "test_accuracy": round(test_acc, 4),
+    })
+    repo = LocalRepo(PRETRAINED_DIR)
+    schema = repo.add_model(bundle, "ConvNet", "UCIDigits")
+    repo.export_manifest()
+    print(f"published {schema.filename} ({schema.size} bytes, "
+          f"sha256 {schema.hash[:12]}...) -> {PRETRAINED_DIR}")
+
+
+if __name__ == "__main__":
+    main()

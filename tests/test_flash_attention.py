@@ -86,6 +86,45 @@ def test_gradients_match_dense():
                dict(rtol=1e-4, atol=1e-5)))
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_dense_4k(causal):
+    """The pallas backward at S=4096 (VERDICT round-3 done-criterion):
+    blocked dQ/dK/dV from the saved LSE vs the dense VJP."""
+    q, k, v = _qkv(b=1, s=4096, h=1, d=32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=1024, block_k=1024) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b),
+            **(dict(rtol=2e-2, atol=3e-2) if ON_TPU else
+               dict(rtol=1e-4, atol=1e-4)))
+
+
+def test_gradients_bf16_and_cross_lengths():
+    """bf16 grads keep the input dtype; Sq != Sk exercises the transposed
+    dK/dV grid."""
+    q, _, _ = _qkv(s=128, d=16, dtype=jnp.bfloat16)
+    _, k, v = _qkv(s=256, d=16, seed=1, dtype=jnp.bfloat16)
+    loss = lambda fn: lambda q_, k_, v_: jnp.sum(
+        fn(q_, k_, v_).astype(jnp.float32) ** 2)
+    gf = jax.grad(loss(lambda a, b, c: flash_attention(
+        a, b, c, block_q=64, block_k=64)), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss(attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-1, atol=1e-1)
+
+
 def test_with_lse_matches_dense_stats():
     """flash_attention_with_lse: output equals dense attention AND the lse
     residual equals the scaled-score logsumexp (the ring merge key)."""

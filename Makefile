@@ -1,6 +1,6 @@
 # Developer entry points (the reference's `runme` + sbt targets,
 # tools/runme/runme.sh:30-52 + src/project/build.scala).
-.PHONY: check test lint bench bench-smoke tpu-floors install docs clean
+.PHONY: check test lint bench bench-smoke tpu-floors install docs notebooks clean
 
 check:            ## full gate: syntax + lint + suite + dryrun + bench smoke
 	bash scripts/check.sh
@@ -25,6 +25,9 @@ install:          ## editable install of the package
 
 docs:             ## regenerate generated API docs (gated by test_api_doc_in_sync)
 	python -c "from mmlspark_tpu.utils import api_summary; open('docs/api.md','w').write(api_summary())"
+
+notebooks:        ## regenerate notebooks/ from examples/ (gated by test_notebooks)
+	python scripts/make_notebooks.py
 
 clean:
 	rm -rf build dist *.egg-info .pytest_cache

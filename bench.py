@@ -106,15 +106,21 @@ def bench_convnet(smoke: bool) -> dict:
     import jax
 
     from mmlspark_tpu import DataTable
-    from mmlspark_tpu.models import ConvNetCIFAR10, ModelBundle, TPUModel
+    from mmlspark_tpu.models import TPUModel
+    from mmlspark_tpu.utils.demo_data import digits_images
     from mmlspark_tpu.utils.perf import mfu
+    from mmlspark_tpu.zoo import ModelDownloader, pretrained_repo
 
     n_images = 2048 if smoke else 32768
     batch = 512 if smoke else 4096
     reps = 1 if smoke else 4
 
-    module = ConvNetCIFAR10()  # bfloat16 compute on the MXU
-    bundle = ModelBundle.init(module, (1, 32, 32, 3), seed=0)
+    # the TRAINED flagship model from the package zoo (scripts/
+    # train_zoo_model.py): throughput and accuracy are measured on the
+    # same weights a user downloads — not a random init
+    dl = ModelDownloader()
+    bundle = dl.load_bundle(dl.download_by_name(pretrained_repo(),
+                                                "ConvNet"))
 
     rng = np.random.default_rng(0)
     # uint8, as a decoder produces them; TPUModel casts on device so the
@@ -133,9 +139,36 @@ def bench_convnet(smoke: bool) -> dict:
         best = min(best, time.perf_counter() - t0)
     assert out["scores"].shape == (n_images, 10)
 
-    images_per_sec = n_images / best / len(jax.devices())
+    n_chips = len(jax.devices())
+    images_per_sec = n_images / best / n_chips
     dev_ips = device_steady_state(model, table, "image", batch,
                                   1 if smoke else 4)
+
+    # Link-normalized headline (docs/perf.md "The 4x gate"): replace the
+    # tunnel's measured per-byte cost with a locally-attached host's
+    # (3 GB/s, conservative PCIe3-class) — the link class the 4xK80
+    # baseline assumed.  Transparent arithmetic over reported fields; on a
+    # local host the correction vanishes.  Clamped so the normalized rate
+    # never exceeds what the chip itself sustains (device rate).
+    link = probe_link_mbps()
+    bytes_h2d = float(imgs.nbytes)
+    bytes_d2h = float(out["scores"].nbytes)
+    tunnel_cost = (bytes_h2d / (link["link_h2d_MBps"] * 1e6)
+                   + bytes_d2h / (link["link_d2h_MBps"] * 1e6))
+    local_cost = (bytes_h2d + bytes_d2h) / 3e9
+    norm_wall = max(best - tunnel_cost + local_cost,
+                    n_images / (dev_ips * n_chips))
+    norm_ips = n_images / norm_wall / n_chips
+
+    # REAL accuracy of the trained weights on the real held-out split —
+    # the north star's equal-accuracy clause, measured on the exact bundle
+    # benchmarked above (reference fixture: ConvNet_CIFAR10.model scored
+    # against expecteds, CNTKTestUtils.scala:12-36)
+    _, _, x_test, y_test = digits_images()
+    scored = model.copy(miniBatchSize=128).transform(
+        DataTable({"image": x_test}))
+    accuracy = float((np.argmax(scored["scores"], axis=1) == y_test).mean())
+
     fpi = _flops_per_image(bundle, (batch, 32, 32, 3), "convnet_cifar10")
     return {
         "metric": "cifar10_convnet_score_images_per_sec_per_chip",
@@ -151,7 +184,15 @@ def bench_convnet(smoke: bool) -> dict:
         # approaches — report its baseline ratio for attribution
         "vs_baseline_device": round(dev_ips / TARGET_IMAGES_PER_SEC_PER_CHIP,
                                     3),
+        # the gate metric (docs/perf.md): e2e with tunnel-excess transfer
+        # time replaced by a local host's, clamped by the device rate
+        "link_normalized_images_per_sec": round(norm_ips, 1),
+        "vs_baseline_link_normalized": round(
+            norm_ips / TARGET_IMAGES_PER_SEC_PER_CHIP, 3),
+        "accuracy": round(accuracy, 4),
+        "accuracy_dataset": "UCI digits held-out (trained zoo bundle)",
         "reps": reps,
+        **link,
     }
 
 
@@ -344,8 +385,9 @@ def main():
     # minutes, and a stale probe would misattribute exactly the way the
     # probe exists to prevent
     print(json.dumps({**bench_resnet50(args.smoke), **probe_link_mbps()}))
-    headline = bench_convnet(args.smoke)
-    print(json.dumps({**headline, **probe_link_mbps()}), flush=True)
+    # bench_convnet embeds its own link probe (taken adjacent to the
+    # normalization arithmetic that uses it)
+    print(json.dumps(bench_convnet(args.smoke)), flush=True)
 
 
 if __name__ == "__main__":

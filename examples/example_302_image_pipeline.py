@@ -1,14 +1,19 @@
 """302 - Pipeline image transformations + transfer learning.
 
 Mirrors the reference's notebook 302 (`notebooks/samples/302 - Pipeline
-Image Transformations.ipynb`): read images from disk (`read_images`, the
-readImages counterpart), run batched ImageTransformer ops (resize, crop,
-flip — the OpenCV stage pipeline), featurize with a truncated zoo model
+Image Transformations.ipynb`): read images from REMOTE storage over HTTP
+(`read_images` against an http:// source — the counterpart of the
+notebook's wasb:// reads, BinaryFileReader.scala:28-69 /
+AzureBlobReader.scala:12-47; a local HTTP server stands in for the blob
+store), run batched ImageTransformer ops (resize, crop, flip — the OpenCV
+stage pipeline), featurize with the TRAINED zoo model's dense1 layer
 (ImageFeaturizer), and train a classifier on the features.
 """
 
+import http.server
 import os
 import tempfile
+import threading
 
 import numpy as np
 
@@ -16,20 +21,24 @@ from mmlspark_tpu.io import read_images
 from mmlspark_tpu.ml import ComputeModelStatistics, LogisticRegression, TrainClassifier
 from mmlspark_tpu.utils.demo_data import cifar_like
 from mmlspark_tpu.vision import ImageFeaturizer, ImageTransformer
-from mmlspark_tpu.zoo import ModelDownloader, create_builtin_repo
+from mmlspark_tpu.zoo import ModelDownloader, pretrained_repo
 
 
 def _write_image_dir(root: str, n: int = 96) -> int:
-    """Materialize a synthetic 2-class image directory tree (the notebook
-    reads a folder of files)."""
+    """Materialize a 2-class image directory tree plus the MANIFEST that
+    makes it HTTP-servable (the zoo-repo listing convention)."""
     from PIL import Image
     data = cifar_like(n=n, seed=5, n_classes=2)
     labels = np.asarray(data["label"], np.int64)
+    rels = []
     for i in range(n):
-        cls_dir = os.path.join(root, f"class{labels[i]}")
-        os.makedirs(cls_dir, exist_ok=True)
+        rel = f"class{labels[i]}/img{i:03d}.png"
+        os.makedirs(os.path.join(root, os.path.dirname(rel)), exist_ok=True)
         arr = data["image"][i][:, :, ::-1]  # BGR -> RGB for PIL
-        Image.fromarray(arr).save(os.path.join(cls_dir, f"img{i:03d}.png"))
+        Image.fromarray(arr).save(os.path.join(root, rel))
+        rels.append(rel)
+    with open(os.path.join(root, "MANIFEST"), "w") as f:
+        f.write("\n".join(rels) + "\n")
     return n
 
 
@@ -38,9 +47,24 @@ def main(verbose: bool = True) -> dict:
     with tempfile.TemporaryDirectory() as root:
         n = _write_image_dir(root, n=96)
 
-        # read the directory tree (readImages counterpart)
-        table = read_images(root, recursive=True)
-        log(f"read {table.num_rows}/{n} images "
+        # serve the directory over HTTP and ingest it REMOTELY: the same
+        # read_images call a gs://-bucket deployment uses (io/remote.py)
+        class _Quiet(http.server.SimpleHTTPRequestHandler):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, directory=root, **kw)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Quiet)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_port}/"
+            table = read_images(url, pattern="*.png")
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        log(f"read {table.num_rows}/{n} images over HTTP "
             f"-> dense tensor {table['image'].shape}")
         labels = np.asarray(
             [0.0 if "class0" in p else 1.0 for p in table["path"]])
@@ -52,11 +76,10 @@ def main(verbose: bool = True) -> dict:
                        .transform(table))
         assert transformed["image"].shape[1:] == (32, 32, 3)
 
-        # transfer learning via the zoo ConvNet's dense1 features
-        repo = create_builtin_repo(os.path.join(root, "zoo"),
-                                   include=["ConvNet"])
+        # transfer learning via the TRAINED zoo ConvNet's dense1 features
         dl = ModelDownloader(os.path.join(root, "cache"))
-        bundle = dl.load_bundle(dl.download_by_name(repo, "ConvNet"))
+        bundle = dl.load_bundle(
+            dl.download_by_name(pretrained_repo(), "ConvNet"))
         feats = ImageFeaturizer(bundle, inputCol="image",
                                 outputCol="features",
                                 cutOutputLayers=1).transform(transformed)

@@ -38,6 +38,7 @@ PIN_EXTRACTORS = {
     },
     "example_301_cifar_eval.py": lambda out: {
         "accuracy": _r(out["accuracy"]),
+        "n_test": int(out["n_test"]),
     },
     "example_302_image_pipeline.py": lambda out: {
         "accuracy": _r(out["accuracy"]),

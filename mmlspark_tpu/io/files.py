@@ -64,7 +64,18 @@ def iter_binary_files(path: str, recursive: bool = False,
     ingestion primitive (the reference streams partitions the same way,
     BinaryFileReader.scala:28-69).  Only one file's bytes are resident at a
     time; corpus size is unbounded by host RAM.
+
+    `path` may also be a remote source — ``http(s)://``, ``gs://``,
+    ``s3://`` — with identical sampling/zip/pattern semantics (io/remote.py,
+    the reference's HDFS/WASB reader seam, AzureBlobReader.scala:12-47);
+    `recursive` is meaningless there (object listings are already flat).
     """
+    from mmlspark_tpu.io.remote import is_remote, iter_remote_binary_files
+    if is_remote(path):
+        yield from iter_remote_binary_files(
+            path, sample_ratio=sample_ratio, inspect_zip=inspect_zip,
+            pattern=pattern, seed=seed)
+        return
     if not 0.0 <= sample_ratio <= 1.0:
         raise ValueError(f"sample_ratio must be in [0,1], got {sample_ratio}")
     rng = np.random.default_rng(seed)

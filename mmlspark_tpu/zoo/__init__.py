@@ -8,12 +8,13 @@ from mmlspark_tpu.zoo.downloader import (
     ModelSchema,
     RemoteRepo,
     create_builtin_repo,
+    pretrained_repo,
     pack_bundle,
     unpack_bundle,
 )
 
 __all__ = [
     "ModelSchema", "ModelDownloader", "LocalRepo", "RemoteRepo",
-    "ModelNotFoundError", "create_builtin_repo", "pack_bundle",
+    "ModelNotFoundError", "create_builtin_repo", "pretrained_repo", "pack_bundle",
     "unpack_bundle",
 ]

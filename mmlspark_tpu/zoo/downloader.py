@@ -222,6 +222,26 @@ class RemoteRepo:
             raise ModelNotFoundError(uri) from e
 
 
+def pretrained_repo() -> LocalRepo:
+    """The package's committed pretrained-model repository.
+
+    The reference serves trained models from a CDN
+    (ModelDownloader.scala:109-157); an air-gapped TPU build ships them as
+    package data instead.  Currently holds ConvNet/UCIDigits — the flagship
+    ConvNetCIFAR10 architecture trained by scripts/train_zoo_model.py on
+    the real UCI handwritten-digits images (98.9% held-out accuracy; see
+    the .meta and bundle metadata for the exact figures).
+    """
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "pretrained")
+    repo = LocalRepo(path)
+    if not list(repo.list_schemas()):
+        raise ModelNotFoundError(
+            f"pretrained repo at {path} is empty; regenerate with "
+            f"scripts/train_zoo_model.py")
+    return repo
+
+
 # --------------------------------------------------------------------------
 # the downloader
 # --------------------------------------------------------------------------

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Generate the .ipynb sample notebooks from the canonical examples.
+
+The reference's demo surface is Jupyter notebooks executed by an nbconvert
+harness (tools/notebook/tester/NotebookTestSuite.py:8-56); here the single
+source of truth is the pinned-metric `.py` example (examples/*.py) and the
+notebook is GENERATED from it: module docstring -> markdown cell, body ->
+code cell, a final cell running main().  Deterministic output (no
+timestamps, fixed ids) so `tests/test_notebooks.py` can enforce freshness
+by regenerating and diffing.
+
+    python scripts/make_notebooks.py        # writes notebooks/*.ipynb
+"""
+
+import ast
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(ROOT, "examples")
+NOTEBOOKS = os.path.join(ROOT, "notebooks")
+
+
+def _cell(kind: str, source: str, idx: int) -> dict:
+    cell = {
+        "cell_type": kind,
+        "id": f"cell-{idx}",
+        "metadata": {},
+        "source": source.splitlines(keepends=True),
+    }
+    if kind == "code":
+        cell.update({"execution_count": None, "outputs": []})
+    return cell
+
+
+def convert(py_path: str) -> dict:
+    src = open(py_path).read()
+    tree = ast.parse(src)
+    doc = ast.get_docstring(tree) or ""
+    # body = source minus the module docstring and the __main__ guard
+    lines = src.splitlines()
+    body_start = tree.body[1].lineno - 1 if (
+        tree.body and isinstance(tree.body[0], ast.Expr)) else 0
+    body_end = len(lines)
+    for node in tree.body:
+        if (isinstance(node, ast.If)
+                and getattr(getattr(node.test, "left", None), "id", "")
+                == "__name__"):
+            body_end = node.lineno - 1
+    body = "\n".join(lines[body_start:body_end]).strip("\n")
+
+    name = os.path.basename(py_path)[:-3]
+    title = name.replace("_", " ")
+    cells = [
+        _cell("markdown", f"# {title}\n\n{doc}", 0),
+        _cell("code", body, 1),
+        _cell("code", "result = main()", 2),
+    ]
+    return {
+        "nbformat": 4,
+        "nbformat_minor": 5,
+        "metadata": {
+            "kernelspec": {"display_name": "Python 3",
+                           "language": "python", "name": "python3"},
+            "language_info": {"name": "python"},
+        },
+        "cells": cells,
+    }
+
+
+def render_all() -> dict:
+    """{notebook filename: json text} for every example."""
+    out = {}
+    for py in sorted(glob.glob(os.path.join(EXAMPLES, "example_*.py"))):
+        nb = convert(py)
+        name = os.path.basename(py)[:-3] + ".ipynb"
+        out[name] = json.dumps(nb, indent=1, sort_keys=True) + "\n"
+    return out
+
+
+def main():
+    os.makedirs(NOTEBOOKS, exist_ok=True)
+    rendered = render_all()
+    for name, text in rendered.items():
+        with open(os.path.join(NOTEBOOKS, name), "w") as f:
+            f.write(text)
+        print(f"wrote notebooks/{name}")
+    for stale in sorted(glob.glob(os.path.join(NOTEBOOKS, "*.ipynb"))):
+        if os.path.basename(stale) not in rendered:
+            os.remove(stale)  # example renamed/removed: drop the orphan
+            print(f"removed stale notebooks/{os.path.basename(stale)}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

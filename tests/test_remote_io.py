@@ -1,0 +1,209 @@
+"""Remote ingestion (io/remote.py): http(s)://, gs://, s3:// sources.
+
+A local HTTP fixture serves one in-memory object store through all three
+protocol surfaces — plain HTTP with a MANIFEST, the GCS JSON listing API,
+and the S3 ListObjectsV2 XML API — so the REAL listing/pagination/download
+code paths run end-to-end with zero network egress (the endpoints are
+config variables).  Counterpart of the reference's remote-FS readers
+(BinaryFileReader.scala:28-69, AzureBlobReader.scala:12-47)."""
+
+import io
+import json
+import threading
+import urllib.parse
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from mmlspark_tpu import config
+from mmlspark_tpu.io.files import iter_binary_files, read_binary_files
+from mmlspark_tpu.io.remote import is_remote, list_remote_files
+
+
+def _png(w=4, h=4, value=128):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), (value, value, value)).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _zip_bytes(entries: dict) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        for name, data in entries.items():
+            zf.writestr(name, data)
+    return buf.getvalue()
+
+
+OBJECTS = {
+    "imgs/a.png": _png(value=10),
+    "imgs/b.png": _png(value=200),
+    "imgs/notes.txt": b"not an image",
+    "imgs/pair.zip": _zip_bytes({"z1.png": _png(value=60),
+                                 "z2.png": _png(value=90)}),
+}
+MANIFEST = "\n".join(OBJECTS) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One object store, three protocol faces."""
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, data: bytes, ctype="application/octet-stream"):
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        parsed = urllib.parse.urlparse(self.path)
+        path = urllib.parse.unquote(parsed.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+
+        # ---- GCS JSON API ------------------------------------------------
+        if path == "/storage/v1/b/bkt/o":
+            prefix = qs.get("prefix", [""])[0]
+            names = sorted(n for n in OBJECTS if n.startswith(prefix))
+            # one-item pages exercise the pagination loop
+            page = int(qs.get("pageToken", ["0"])[0])
+            body = {"items": [{"name": names[page]}]} if page < len(names) \
+                else {"items": []}
+            if page + 1 < len(names):
+                body["nextPageToken"] = str(page + 1)
+            return self._send(json.dumps(body).encode(), "application/json")
+        if path.startswith("/storage/v1/b/bkt/o/"):
+            name = path[len("/storage/v1/b/bkt/o/"):]
+            if qs.get("alt") == ["media"] and name in OBJECTS:
+                return self._send(OBJECTS[name])
+            self.send_error(404)
+            return None
+
+        # ---- S3 XML API --------------------------------------------------
+        if path == "/bkt" and qs.get("list-type") == ["2"]:
+            prefix = qs.get("prefix", [""])[0]
+            names = sorted(n for n in OBJECTS if n.startswith(prefix))
+            start = int(qs.get("continuation-token", ["0"])[0])
+            chunk = names[start:start + 2]  # two-item pages
+            nxt = (f"<NextContinuationToken>{start + 2}"
+                   "</NextContinuationToken>") if start + 2 < len(names) \
+                else ""
+            xml = ('<?xml version="1.0"?>'
+                   '<ListBucketResult xmlns='
+                   '"http://s3.amazonaws.com/doc/2006-03-01/">'
+                   + "".join(f"<Contents><Key>{n}</Key></Contents>"
+                             for n in chunk) + nxt + "</ListBucketResult>")
+            return self._send(xml.encode(), "application/xml")
+        if path.startswith("/bkt/"):
+            name = path[len("/bkt/"):]
+            if name in OBJECTS:
+                return self._send(OBJECTS[name])
+            self.send_error(404)
+            return None
+
+        # ---- plain HTTP directory ---------------------------------------
+        if path == "/files/MANIFEST":
+            return self._send(MANIFEST.encode(), "text/plain")
+        if path.startswith("/files/"):
+            name = path[len("/files/"):]
+            if name in OBJECTS:
+                return self._send(OBJECTS[name])
+        self.send_error(404)
+        return None
+
+
+@pytest.fixture(scope="module")
+def server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_port}"
+    config.set("MMLSPARK_TPU_GCS_ENDPOINT", base)
+    config.set("MMLSPARK_TPU_S3_ENDPOINT", base)
+    yield base
+    config.set("MMLSPARK_TPU_GCS_ENDPOINT", None)
+    config.set("MMLSPARK_TPU_S3_ENDPOINT", None)
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_is_remote():
+    assert is_remote("http://x/") and is_remote("gs://b/p")
+    assert is_remote("s3://b/p") and not is_remote("/tmp/x")
+
+
+def test_http_directory_enumeration(server):
+    got = dict(iter_binary_files(f"{server}/files/"))
+    # the zip expands into entries; everything else arrives verbatim
+    assert f"{server}/files/imgs/a.png" in got
+    assert got[f"{server}/files/imgs/a.png"] == OBJECTS["imgs/a.png"]
+    assert f"{server}/files/imgs/pair.zip/z1.png" in got
+    assert len(got) == 5  # 3 plain files + 2 zip entries
+
+
+def test_http_single_file(server):
+    got = list(iter_binary_files(f"{server}/files/imgs/b.png"))
+    assert got == [(f"{server}/files/imgs/b.png", OBJECTS["imgs/b.png"])]
+
+
+def test_pattern_and_no_zip(server):
+    got = dict(iter_binary_files(f"{server}/files/", pattern="*.png",
+                                 inspect_zip=False))
+    assert {p.rsplit("/", 1)[1] for p in got} == {"a.png", "b.png"}
+
+
+def test_sample_ratio_subsamples_deterministically(server):
+    full = dict(iter_binary_files(f"{server}/files/", seed=3))
+    once = dict(iter_binary_files(f"{server}/files/", sample_ratio=0.5,
+                                  seed=3))
+    again = dict(iter_binary_files(f"{server}/files/", sample_ratio=0.5,
+                                   seed=3))
+    assert once == again
+    assert set(once) < set(full)
+
+
+def test_gcs_listing_paginates_and_downloads(server):
+    entries = list_remote_files("gs://bkt/imgs/")
+    assert [p for p, _ in entries] == [f"gs://bkt/{n}" for n in
+                                       sorted(OBJECTS)]
+    got = dict(iter_binary_files("gs://bkt/imgs/", pattern="*.png",
+                                 inspect_zip=False))
+    assert got["gs://bkt/imgs/a.png"] == OBJECTS["imgs/a.png"]
+
+
+def test_s3_listing_paginates_and_downloads(server):
+    got = dict(iter_binary_files("s3://bkt/imgs/", inspect_zip=True))
+    assert len(got) == 5
+    assert got["s3://bkt/imgs/b.png"] == OBJECTS["imgs/b.png"]
+
+
+def test_read_binary_files_table_over_http(server):
+    table = read_binary_files(f"{server}/files/", pattern="*.png",
+                              inspect_zip=False)
+    assert table.num_rows == 2
+    assert table["bytes"][0] == OBJECTS["imgs/a.png"]
+
+
+def test_read_images_over_http(server):
+    """The full image-ingestion flow against a remote source: enumerate ->
+    download -> decode -> dense uint8 batch (readers seam,
+    ImageReader.scala:25-62)."""
+    from mmlspark_tpu.io.image_reader import read_images
+
+    table = read_images(f"{server}/files/", pattern="*.png",
+                        inspect_zip=False)
+    assert table["image"].shape == (2, 4, 4, 3)
+    # PNG round-trip: solid gray values survive decode exactly
+    assert int(table["image"][0, 0, 0, 0]) == 10
+
+
+def test_unreachable_host_raises_not_hangs():
+    config.set("MMLSPARK_TPU_REMOTE_TIMEOUT_S", 2.0)
+    try:
+        with pytest.raises(Exception):
+            list(iter_binary_files("http://127.0.0.1:9/files/"))
+    finally:
+        config.set("MMLSPARK_TPU_REMOTE_TIMEOUT_S", None)

@@ -212,11 +212,85 @@ class IDF(Estimator):
 
 class TextFeaturizerModel(PipelineModel):
     """Fitted text chain; drops the intermediate token/tf columns
-    (reference TextFeaturizerModel, TextFeaturizer.scala:350-367)."""
+    (reference TextFeaturizerModel, TextFeaturizer.scala:350-367).
+
+    When the chain's prefix is the default shape — Tokenizer(gaps, \\s+)
+    [-> StopWordsRemover] -> HashingTF — scoring runs it as ONE fused C++
+    sweep over the raw strings (native/text.cpp: no Python token objects
+    materialized), byte-identical to the staged path; rows the kernel
+    declines (non-ASCII: unicode tables stay in Python) and any remaining
+    stages (IDF) run through the ordinary stage path.  The stages remain
+    the source of truth for params and persistence."""
 
     def __init__(self, stages=None, cols_to_drop: Optional[list] = None, **kw):
         super().__init__(stages, **kw)
         self._drop = list(cols_to_drop or [])
+
+    def _fused_prefix(self):
+        """(n_stages_fused, kwargs for native_text_hash) or None."""
+        stages = self._stages
+        if not stages or not isinstance(stages[0], Tokenizer):
+            return None
+        tok = stages[0]
+        if not tok.gaps or tok.pattern != r"\s+":
+            return None
+        i, stop_words, case_sensitive = 1, [], False
+        cur_col = tok.outputCol
+        if i < len(stages) and isinstance(stages[i], StopWordsRemover):
+            sw = stages[i]
+            if sw.inputCol != cur_col:
+                return None  # non-linear wiring: fusion would change results
+            cur_col = sw.outputCol
+            words = (list(sw.stopWords) if sw.stopWords is not None
+                     else sorted(ENGLISH_STOP_WORDS))
+            case_sensitive = sw.caseSensitive
+            stop_words = words if case_sensitive else \
+                [w.lower() for w in words]
+            if any(ord(c) > 127 for w in stop_words for c in w):
+                return None  # non-ASCII stop words: python path only
+            i += 1
+        if i >= len(stages) or not isinstance(stages[i], HashingTF):
+            return None
+        tf = stages[i]
+        if tf.inputCol != cur_col:
+            return None  # stage chain is not a straight line
+        return i + 1, dict(
+            stopwords=stop_words,
+            lowercase=tok.toLowercase,
+            # membership tests t.lower() when the remover is
+            # case-insensitive but tokens were not already lowercased
+            lower_for_stop=(not case_sensitive and not tok.toLowercase),
+            min_token_len=tok.minTokenLength,
+            num_features=tf.numFeatures,
+            binary=tf.binary,
+        ), tok.inputCol, tf.outputCol, tf
+
+    def _transform_fused(self, table: DataTable):
+        from mmlspark_tpu.native_loader import native_text_hash
+        spec = self._fused_prefix()
+        if spec is None:
+            return None
+        n_fused, kwargs, in_col, tf_col, tf_stage = spec
+        docs = list(table[in_col])
+        result = native_text_hash(docs, **kwargs)
+        if result is None:
+            return None
+        rows, fallback = result
+        if fallback:
+            # non-ASCII rows: exact recompute through the python stages
+            sub = DataTable({in_col: _object_column(
+                [docs[i] for i in fallback])})
+            for st in self._stages[:n_fused]:
+                sub = st.transform(sub)
+            for j, i in enumerate(fallback):
+                rows[i] = sub[tf_col][j]
+        out = table.with_column(tf_col, _object_column(rows))
+        meta = out.meta(tf_col)
+        meta.extra.update(num_features=tf_stage.numFeatures, sparse=True)
+        out.set_meta(tf_col, meta)
+        for st in self._stages[n_fused:]:
+            out = st.transform(out)
+        return out
 
     def transform(self, table: DataTable) -> DataTable:
         clash = [c for c in self._drop if c in table]
@@ -224,7 +298,9 @@ class TextFeaturizerModel(PipelineModel):
             raise ValueError(
                 f"input table already has columns {clash}, which this fitted "
                 "model uses as intermediates; rename them before scoring")
-        out = super().transform(table)
+        out = self._transform_fused(table)
+        if out is None:
+            out = super().transform(table)
         return out.drop(*[c for c in self._drop if c in out])
 
     def _save_extra(self, path: str) -> None:

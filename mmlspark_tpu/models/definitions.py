@@ -200,12 +200,13 @@ class TransformerBlock(nn.Module):
     n_heads: int
     mlp_ratio: int = 4
     dtype: Dtype = jnp.bfloat16
-    attn_impl: str = "dense"          # dense | flash | ring | ulysses
-    seq_axis: Optional[str] = None    # mesh axis for ring/ulysses
+    attn_impl: str = "dense"    # dense | flash | ring | ring_flash | ulysses
+    seq_axis: Optional[str] = None    # mesh axis for ring variants/ulysses
 
     @nn.compact
     def __call__(self, x):
         from mmlspark_tpu.ops.attention import (attention, ring_attention,
+                                                ring_flash_attention,
                                                 ulysses_attention)
         b, s, _ = x.shape
         d_head = self.d_model // self.n_heads
@@ -223,6 +224,11 @@ class TransformerBlock(nn.Module):
             o = flash_attention(q, k, v, causal=True)
         elif self.attn_impl == "ring":
             o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        elif self.attn_impl == "ring_flash":
+            # flash local op + LSE ring merge, differentiable (custom VJP):
+            # the long-context TRAINING configuration
+            o = ring_flash_attention(q, k, v, axis_name=self.seq_axis,
+                                     causal=True)
         elif self.attn_impl == "ulysses":
             o = ulysses_attention(q, k, v, axis_name=self.seq_axis,
                                   causal=True)
@@ -242,7 +248,8 @@ class TransformerLM(nn.Module, NodeMixin):
     """Decoder-only language model — the long-context flagship.
 
     New-design headroom over the reference (which has no sequence axis,
-    SURVEY §5): with attn_impl='ring'/'ulysses' and seq_axis set, the model
+    SURVEY §5): with attn_impl='ring'/'ring_flash'/'ulysses' and seq_axis
+    set, the model
     runs under shard_map with its sequence sharded over the mesh
     (parallel/ring.py), and position embeddings use GLOBAL positions
     derived from the device's ring index.  Named nodes: embed, block0..N,

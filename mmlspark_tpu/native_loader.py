@@ -21,8 +21,8 @@ from typing import Optional
 import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
-_SOURCES = ["decode.cpp"]
-_LINK_LIBS = ["-ljpeg", "-lpng"]
+_SOURCES = ["decode.cpp", "text.cpp"]
+_LINK_LIBS = ["-ljpeg", "-lpng", "-lz"]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -86,6 +86,24 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
                 ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_int)]
+            lib.text_hash_count.restype = ctypes.c_int
+            lib.text_hash_count.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long,
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long,
+                ctypes.c_int, ctypes.c_int, ctypes.c_long, ctypes.c_long,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_long)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte))]
+            lib.text_hash_free.restype = None
+            lib.text_hash_free.argtypes = [
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_ubyte)]
             _lib = lib
         except Exception:
             _load_failed = True
@@ -161,3 +179,67 @@ def native_decode_batch(buffers: list) -> Optional[list]:
         if status[j] == 0:
             results[i] = outs[j]
     return results
+
+
+def native_text_hash(docs: list, stopwords: list, lowercase: bool,
+                     lower_for_stop: bool, min_token_len: int,
+                     num_features: int, binary: bool) -> Optional[tuple]:
+    """Fused tokenize->stop->hash->count over raw document strings.
+
+    Returns (rows, fallback_idx): `rows[i]` is the (slot_ids int32, vals
+    float32) sparse row for doc i (None where i is in fallback_idx —
+    non-ASCII documents the caller recomputes through the Python stages,
+    which own the unicode tables), or None entirely when the native lib
+    is absent.  `None` cells tokenize to [] (the Tokenizer contract).
+    """
+    lib = get_native_lib()
+    if lib is None or num_features > 2**31 - 1:
+        return None
+    enc = [("" if d is None else str(d)).encode("utf-8") for d in docs]
+    buf = b"".join(enc)
+    offsets = np.zeros(len(enc) + 1, np.int64)
+    np.cumsum([len(e) for e in enc], out=offsets[1:])
+    senc = [s.encode("utf-8") for s in stopwords]
+    sbuf = b"".join(senc)
+    soff = np.zeros(len(senc) + 1, np.int64)
+    np.cumsum([len(e) for e in senc], out=soff[1:])
+
+    slots_p = ctypes.POINTER(ctypes.c_int)()
+    vals_p = ctypes.POINTER(ctypes.c_float)()
+    bounds_p = ctypes.POINTER(ctypes.c_long)()
+    status_p = ctypes.POINTER(ctypes.c_ubyte)()
+    rc = lib.text_hash_count(
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        len(enc),
+        sbuf, soff.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), len(senc),
+        int(lowercase), int(lower_for_stop), int(min_token_len),
+        int(num_features), int(binary),
+        ctypes.byref(slots_p), ctypes.byref(vals_p), ctypes.byref(bounds_p),
+        ctypes.byref(status_p))
+    if rc != 0:
+        return None
+    try:
+        n = len(enc)
+        bounds = np.ctypeslib.as_array(bounds_p, shape=(n + 1,)).copy()
+        total = int(bounds[-1])
+        slots = (np.ctypeslib.as_array(slots_p, shape=(total,)).copy()
+                 if total else np.zeros(0, np.int32))
+        vals = (np.ctypeslib.as_array(vals_p, shape=(total,)).copy()
+                if total else np.zeros(0, np.float32))
+        status = np.ctypeslib.as_array(status_p, shape=(n,)).copy() \
+            if n else np.zeros(0, np.uint8)
+    finally:
+        lib.text_hash_free(slots_p, vals_p, bounds_p, status_p)
+    rows: list = []
+    fallback = np.nonzero(status)[0].tolist()
+    fb = set(fallback)
+    for i in range(n):
+        if i in fb:
+            rows.append(None)
+        else:
+            # plain slices: slots/vals are already int32/float32 copies we
+            # own, so per-row astype would just duplicate the hot path's
+            # output again
+            rows.append((slots[bounds[i]:bounds[i + 1]],
+                         vals[bounds[i]:bounds[i + 1]]))
+    return rows, fallback

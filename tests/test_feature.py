@@ -227,3 +227,78 @@ def test_featurize_multiple_groups(mixed_table):
     out = model.transform(mixed_table)
     assert out["f1"].shape == (8, 2)
     assert out["f2"].shape[0] == 8 and out["f2"].shape[1] > 0
+
+
+# --------------------------------------------------------------------------
+# fused C++ text path (native/text.cpp): byte-identical to the staged chain
+# --------------------------------------------------------------------------
+
+def _staged(model, table):
+    """The pure-python stage chain (bypasses the fused override)."""
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    out = PipelineModel.transform(model, table)
+    return out.drop(*[c for c in model._drop if c in out])
+
+
+def _rows_equal(a_col, b_col):
+    assert len(a_col) == len(b_col)
+    for a, b in zip(a_col, b_col):
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+
+@pytest.mark.parametrize("use_stop,binary,lower,min_len", [
+    (False, False, True, 0),
+    (True, False, True, 0),
+    (True, True, False, 3),
+])
+def test_fused_text_path_matches_staged(use_stop, binary, lower, min_len):
+    from mmlspark_tpu.core.table import object_column
+    """The fused C++ sweep must reproduce the staged Tokenizer ->
+    [StopWordsRemover] -> HashingTF chain exactly — incl. None cells,
+    empty docs, unicode rows (which fall back per row), stop words,
+    minTokenLength, and binary counts."""
+    from mmlspark_tpu.feature.text import TextFeaturizer
+
+    docs = ["The quick brown Fox  jumps\tover the lazy dog",
+            None, "", "   ", "a an the THE",
+            "café au lait très bon the",   # unicode -> fallback row
+            "counts counts counts unique",
+            "\x1cweird\x1dseparators\x1eeverywhere\x1f ok"]
+    table = DataTable({"text": object_column(docs)})
+    feat = TextFeaturizer(inputCol="text", outputCol="feats",
+                          useStopWordsRemover=use_stop, binary=binary,
+                          toLowercase=lower, minTokenLength=min_len,
+                          useIDF=False, numFeatures=1 << 12)
+    model = feat.fit(table)
+    # the fused path must actually be eligible AND the native lib built —
+    # otherwise this parity test compares staged against staged (and a
+    # text.cpp build break would silently disable the whole native layer,
+    # image decoder included)
+    from mmlspark_tpu.native_loader import get_native_lib
+    assert get_native_lib() is not None
+    assert model._fused_prefix() is not None
+    fused = model.transform(table)
+    staged = _staged(model, table)
+    _rows_equal(fused["feats"], staged["feats"])
+
+
+def test_fused_text_path_with_idf_and_ngram_gate():
+    """IDF composes after the fused prefix; an NGram stage disables the
+    fusion (exact staged fallback)."""
+    from mmlspark_tpu.core.table import object_column
+    from mmlspark_tpu.feature.text import TextFeaturizer
+
+    docs = ["alpha beta gamma", "beta gamma delta", "gamma delta epsilon"]
+    table = DataTable({"text": object_column(docs)})
+    with_idf = TextFeaturizer(inputCol="text", outputCol="f",
+                              useIDF=True, numFeatures=256).fit(table)
+    assert with_idf._fused_prefix() is not None
+    _rows_equal(with_idf.transform(table)["f"],
+                _staged(with_idf, table)["f"])
+
+    ngram = TextFeaturizer(inputCol="text", outputCol="f", useNGram=True,
+                           useIDF=False, numFeatures=256).fit(table)
+    assert ngram._fused_prefix() is None
+    out = ngram.transform(table)  # staged path still works
+    assert len(out["f"]) == 3

@@ -117,7 +117,7 @@ def test_transformer_lm_seq_parallel_forward_matches_dense(seq_mesh):
         np.abs(got - expected).max()
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
 def test_seq_parallel_lm_train_step(seq_mesh, impl):
     """One seq-parallel train step must run and reduce loss on repetition."""
     rng = np.random.default_rng(2)

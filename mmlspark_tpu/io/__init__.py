@@ -1,6 +1,12 @@
-"""IO layer: binary-file and image ingestion (reference L2: readers/)."""
+"""IO layer: binary-file, image, remote, and SQL ingestion
+(reference L2: readers/)."""
 
-from mmlspark_tpu.io.files import list_files, read_binary_files
-from mmlspark_tpu.io.image_reader import decode_bytes, read_images
+from mmlspark_tpu.io.files import (iter_binary_files, list_files,
+                                   read_binary_files)
+from mmlspark_tpu.io.image_reader import (decode_bytes, read_images,
+                                          read_images_iter)
+from mmlspark_tpu.io.sql import iter_sql, read_sql
 
-__all__ = ["list_files", "read_binary_files", "read_images", "decode_bytes"]
+__all__ = ["list_files", "iter_binary_files", "read_binary_files",
+           "read_images", "read_images_iter", "decode_bytes",
+           "read_sql", "iter_sql"]

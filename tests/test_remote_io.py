@@ -14,6 +14,7 @@ import urllib.parse
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import numpy as np
 import pytest
 
 from mmlspark_tpu import config
@@ -207,3 +208,84 @@ def test_unreachable_host_raises_not_hangs():
             list(iter_binary_files("http://127.0.0.1:9/files/"))
     finally:
         config.set("MMLSPARK_TPU_REMOTE_TIMEOUT_S", None)
+
+
+# --------------------------------------------------------------------------
+# SQL ingestion (io/sql.py, the AzureSQLReader.scala:12-29 counterpart)
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def sqlite_db(tmp_path):
+    import sqlite3
+    path = str(tmp_path / "t.db")
+    conn = sqlite3.connect(path)
+    conn.execute("CREATE TABLE pts (x REAL, n INTEGER, name TEXT, note TEXT)")
+    conn.executemany(
+        "INSERT INTO pts VALUES (?, ?, ?, ?)",
+        [(i * 0.5, i, f"row{i}", None if i % 3 == 0 else f"n{i}")
+         for i in range(10)])
+    conn.commit()
+    conn.close()
+    return path
+
+
+def test_read_sql_types_and_nulls(sqlite_db):
+    from mmlspark_tpu.io import read_sql
+
+    t = read_sql("SELECT * FROM pts ORDER BY n", sqlite_db)
+    assert t.num_rows == 10
+    assert t["x"].dtype == np.float64 and t["x"][3] == 1.5
+    assert t["n"].dtype == np.int64
+    assert t["name"].dtype == object and t["name"][2] == "row2"
+    assert t["note"][0] is None and t["note"][1] == "n1"
+
+
+def test_iter_sql_streams_batches(sqlite_db):
+    from mmlspark_tpu.io import iter_sql
+
+    batches = list(iter_sql("SELECT n FROM pts ORDER BY n", sqlite_db,
+                            batch_rows=4))
+    assert [b.num_rows for b in batches] == [4, 4, 2]
+    assert batches[2]["n"].tolist() == [8, 9]
+
+
+def test_read_sql_empty_result_keeps_schema(sqlite_db):
+    from mmlspark_tpu.io import read_sql
+
+    t = read_sql("SELECT x, name FROM pts WHERE n > 99", sqlite_db)
+    assert t.num_rows == 0 and t.columns == ["x", "name"]
+
+
+def test_sql_feeds_scoring_pipeline(sqlite_db):
+    """Score-from-database: iter_sql batches straight into
+    TPUModel.transform_batches (the reference's SQL -> scoring flow)."""
+    from mmlspark_tpu.io import iter_sql
+    from mmlspark_tpu.models import MLPClassifier, ModelBundle, TPUModel
+
+    bundle = ModelBundle.init(MLPClassifier(hidden_sizes=(4,), num_classes=2),
+                              (1, 2), seed=0)
+    model = TPUModel(bundle, inputCol="f", outputCol="s", miniBatchSize=8)
+    def batches():
+        for b in iter_sql("SELECT x, n FROM pts ORDER BY n", sqlite_db,
+                          batch_rows=4):
+            yield b.with_column(
+                "f", np.stack([b["x"], b["n"].astype(np.float64)], 1)
+                .astype(np.float32))
+    scored = list(model.transform_batches(batches()))
+    assert [s["s"].shape for s in scored] == [(4, 2), (4, 2), (2, 2)]
+
+
+def test_iter_sql_dtypes_stable_across_batches(sqlite_db):
+    """An INTEGER column whose first NULL appears in a later batch must not
+    flip dtype mid-stream (jitted consumers retrace on dtype changes):
+    numeric streaming columns are float64 from the first batch onward."""
+    import sqlite3
+
+    from mmlspark_tpu.io import iter_sql
+    conn = sqlite3.connect(sqlite_db)
+    conn.execute("INSERT INTO pts VALUES (99.0, NULL, 'late-null', 'x')")
+    conn.commit()
+    conn.close()
+    batches = list(iter_sql("SELECT n FROM pts", sqlite_db, batch_rows=4))
+    assert all(b["n"].dtype == np.float64 for b in batches)
+    assert np.isnan(batches[-1]["n"][-1])

@@ -202,6 +202,9 @@ class TransformerBlock(nn.Module):
     dtype: Dtype = jnp.bfloat16
     attn_impl: str = "dense"    # dense | flash | ring | ring_flash | ulysses
     seq_axis: Optional[str] = None    # mesh axis for ring variants/ulysses
+    mlp_impl: str = "dense"           # dense | moe
+    n_experts: int = 8                # experts when mlp_impl == "moe"
+    expert_axis: Optional[str] = None  # mesh axis experts shard over (EP)
 
     @nn.compact
     def __call__(self, x):
@@ -237,6 +240,14 @@ class TransformerBlock(nn.Module):
         x = x + nn.Dense(self.d_model, dtype=self.dtype,
                          name="proj")(o.reshape(b, s, self.d_model))
         h = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.mlp_impl == "moe":
+            # sparse conditional compute: Switch top-1 experts (ops/moe.py);
+            # the expert dimension shards over `expert_axis` via
+            # expert_parallel_rules (GSPMD EP)
+            from mmlspark_tpu.ops.moe import MoEMLP
+            return x + MoEMLP(self.d_model, n_experts=self.n_experts,
+                              mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+                              expert_axis=self.expert_axis, name="moe")(h)
         h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype,
                      name="mlp_up")(h)
         h = nn.gelu(h)
@@ -265,6 +276,9 @@ class TransformerLM(nn.Module, NodeMixin):
     dtype: Dtype = jnp.bfloat16
     attn_impl: str = "dense"
     seq_axis: Optional[str] = None
+    mlp_impl: str = "dense"            # dense | moe (Switch top-1 experts)
+    n_experts: int = 8
+    expert_axis: Optional[str] = None  # mesh axis for expert parallelism
 
     @nn.compact
     def __call__(self, tokens):
@@ -283,7 +297,8 @@ class TransformerLM(nn.Module, NodeMixin):
         for i in range(self.n_layers):
             x = TransformerBlock(
                 self.d_model, self.n_heads, self.mlp_ratio, self.dtype,
-                self.attn_impl, self.seq_axis, name=f"block{i}_w")(x)
+                self.attn_impl, self.seq_axis, self.mlp_impl,
+                self.n_experts, self.expert_axis, name=f"block{i}_w")(x)
             x = self.node(f"block{i}", x)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm_w")(x)
         x = self.node("final_norm", x)

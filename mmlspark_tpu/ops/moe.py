@@ -1,0 +1,127 @@
+"""Mixture-of-Experts with expert parallelism (EP) over a mesh axis.
+
+New-design headroom over the reference (which has no sparse/conditional
+compute at all — SURVEY §2b): a Switch-style top-1 MoE MLP.  Expert
+parallelism follows the GSPMD recipe rather than hand-written collectives:
+the stacked expert weights (E, D, H) are sharded over a mesh axis
+(`expert_parallel_rules`), the dispatched slot tensor (E, C, D) carries a
+matching sharding constraint, and XLA inserts the all_to_all / all_gather
+traffic — the "annotate shardings, let the compiler place collectives"
+discipline the rest of the framework uses for TP/DP.
+
+Design for XLA: everything is static-shape.  Routing uses the classic
+dispatch/combine one-hot formulation (einsum-only — no gather/scatter, no
+dynamic shapes), with a fixed per-expert capacity
+`C = ceil(T / E * capacity_factor)`; tokens beyond an expert's capacity
+are dropped (their residual stream passes through unchanged), exactly the
+Switch Transformer discipline.  The load-balance auxiliary loss
+`E * Σ_e f_e · p_e` is sown into the `"losses"` collection for training
+loops to add (weighted) to the objective.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from mmlspark_tpu.parallel.mesh import MODEL_AXIS
+
+
+def top1_dispatch(router_logits: jax.Array, capacity: int):
+    """(dispatch (T,E,C), combine (T,E,C), aux_loss) from router logits.
+
+    float32 routing throughout (softmax statistics must not ride bf16).
+    `dispatch` places each kept token in its expert's next free slot;
+    `combine` additionally scales by the router gate, so
+    `y = combine^T · expert(dispatch · x)` is the Switch forward.
+    """
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                   # (T,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T,E)
+    # position of each token within its expert's queue (first-come order,
+    # the deterministic Switch tie-break)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # (T,E)
+    within = (pos < capacity) & (pos >= 0)
+    pos_oh = jax.nn.one_hot(pos.max(axis=-1).astype(jnp.int32), capacity,
+                            dtype=jnp.float32)                 # (T,C)
+    dispatch = (onehot * within)[:, :, None] * pos_oh[:, None, :]
+    combine = dispatch * gate[:, None, None]
+    f = onehot.mean(axis=0)                                    # (E,)
+    p = probs.mean(axis=0)
+    aux = e * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP replacement: router -> top-1 experts -> combine.
+
+    `expert_axis` names the mesh axis the (E, ...) tensors shard over; it
+    only places a `with_sharding_constraint` on the slot tensor (harmless
+    outside jit/mesh contexts where it is a no-op on CPU tests), the
+    weight shardings themselves come from `expert_parallel_rules`.
+    """
+
+    d_model: int
+    n_experts: int = 8
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+    expert_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, d = x.shape
+        t = b * s
+        e = self.n_experts
+        h = self.mlp_ratio * self.d_model
+        capacity = max(1, int(np.ceil(t / e * self.capacity_factor)))
+
+        xf = x.reshape(t, d)
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            xf.astype(jnp.float32))
+        dispatch, combine, aux = top1_dispatch(logits, capacity)
+        self.sow("losses", "moe_aux_loss", aux)
+
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (e, d, h), jnp.float32)
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (e, h, d), jnp.float32)
+
+        slots = jnp.einsum("tec,td->ecd", dispatch,
+                           xf.astype(jnp.float32)).astype(self.dtype)
+        if self.expert_axis is not None:
+            try:
+                from jax.sharding import PartitionSpec as P
+                slots = jax.lax.with_sharding_constraint(
+                    slots, P(self.expert_axis))
+            except (ValueError, RuntimeError):
+                pass  # no mesh in scope (eager CPU tests): constraint is moot
+        hmid = nn.relu(jnp.einsum("ecd,edh->ech", slots,
+                                  w_in.astype(self.dtype)))
+        out = jnp.einsum("ech,ehd->ecd", hmid, w_out.astype(self.dtype))
+        y = jnp.einsum("tec,ecd->td", combine, out.astype(jnp.float32))
+        return y.astype(x.dtype).reshape(b, s, d)
+
+
+def expert_parallel_rules(params: dict, mesh,
+                          axis: str = MODEL_AXIS) -> dict:
+    """NamedSharding tree for a param tree containing MoE experts: (E, ...)
+    expert tensors shard their leading (expert) dim over `axis`; everything
+    else replicates.  Feed to `jax.device_put` / `jit(in_shardings=...)` —
+    XLA then places the EP all_to_all traffic (GSPMD).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def rule(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("w_in", "w_out") and leaf.ndim == 3:
+            return NamedSharding(mesh, P(axis, None, None))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(rule, params)

@@ -1,0 +1,192 @@
+"""Pipeline parallelism (PP): GPipe-style microbatched stage pipeline.
+
+New-design headroom over the reference (whose only model distribution was
+a broadcast copy per executor — SURVEY §2b): the transformer block stack
+is partitioned over a mesh axis, one stage per device group, and
+microbatches flow through the ring.
+
+TPU-first mechanics, all inside one `shard_map`:
+
+  * layer params are STACKED on a leading layer dim and sharded over the
+    stage axis, so each device holds only its own stage's weights — the
+    memory win that motivates PP;
+  * the schedule is a `lax.scan` over `n_micro + n_stages - 1` ticks; at
+    each tick every stage applies its layers to its current activation
+    and `ppermute`s the result one hop down the ring (stage 0 injects a
+    fresh microbatch, the last stage banks its finished one).  Bubble
+    fraction is the usual (S-1)/(M+S-1);
+  * the BACKWARD schedule is not hand-written: jax differentiates through
+    scan + ppermute, producing the reverse pipeline automatically (XLA
+    transposes ppermute into the opposite rotation).
+
+Embedding / final-norm / LM-head stay replicated (they are a sliver of
+the FLOPs); the data axis composes orthogonally — tokens shard over
+'data' while stages ride the stage axis, so dp x pp runs in one jit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mmlspark_tpu.models.definitions import TransformerBlock
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from mmlspark_tpu.parallel.ring import _shard_map
+
+
+def init_pipelined_lm(rng, *, vocab_size: int, d_model: int, n_heads: int,
+                      n_layers: int, max_len: int, mlp_ratio: int = 4,
+                      dtype=jnp.float32) -> dict:
+    """Parameter tree for the pipelined LM: block params stacked on a
+    leading layer dim (leaves (L, ...)), plus replicated embed/norm/head."""
+    block = TransformerBlock(d_model, n_heads, mlp_ratio, dtype)
+    x = jnp.zeros((1, max_len, d_model), dtype)
+    keys = jax.random.split(rng, n_layers + 2)
+    stacked = jax.vmap(
+        lambda k: block.init(k, x)["params"])(keys[:n_layers])
+    k_e, k_h = keys[n_layers], keys[n_layers + 1]
+    scale = d_model ** -0.5
+    return {
+        "tok_embed": jax.random.normal(k_e, (vocab_size, d_model)) * scale,
+        "pos_embed": jax.random.normal(
+            jax.random.fold_in(k_e, 1), (max_len, d_model)) * scale,
+        "blocks": stacked,
+        "norm_scale": jnp.ones((d_model,)),
+        "norm_bias": jnp.zeros((d_model,)),
+        "head": jax.random.normal(k_h, (d_model, vocab_size)) * scale,
+    }
+
+
+def _embed(params, tokens):
+    x = params["tok_embed"][tokens] + params["pos_embed"][: tokens.shape[1]]
+    return x
+
+
+def _head(params, x):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    x = (x - mu) * jax.lax.rsqrt(var + 1e-6)
+    x = x * params["norm_scale"] + params["norm_bias"]
+    return x @ params["head"]
+
+
+def _apply_stage(block: TransformerBlock, local_blocks, x):
+    """Apply this stage's stacked layers (L_local, ...) sequentially."""
+    def body(h, layer_params):
+        return block.apply({"params": layer_params}, h), None
+    out, _ = lax.scan(body, x, local_blocks)
+    return out
+
+
+def _pipeline_blocks(block, local_blocks, x, stage_axis: str, n_micro: int):
+    """The GPipe schedule proper (runs inside shard_map)."""
+    n_stages = lax.psum(1, stage_axis)
+    idx = lax.axis_index(stage_axis)
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} must divide into n_micro={n_micro}")
+    mb = b // n_micro
+    xs = x.reshape(n_micro, mb, *x.shape[1:])
+    ticks = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 injects microbatch t; later stages consume the ring buf.
+        # Ticks past the last injection re-feed a stale microbatch whose
+        # results never reach a valid output slot (they would arrive after
+        # the final tick), so no masking of the compute itself is needed.
+        cur = jnp.where(idx == 0, xs[jnp.clip(t, 0, n_micro - 1)], buf)
+        y = _apply_stage(block, local_blocks, cur)
+        m = t - (n_stages - 1)
+        valid = (m >= 0) & (idx == n_stages - 1)
+        mclip = jnp.clip(m, 0, n_micro - 1)
+        outs = outs.at[mclip].set(jnp.where(valid, y, outs[mclip]))
+        buf = lax.ppermute(y, stage_axis, perm)
+        return (buf, outs), None
+
+    # the carry becomes stage-varying inside the loop (y depends on this
+    # stage's weights), so its initial value must carry that
+    # varying-manual-axes type too (the shard_map scan rule)
+    mark = lambda a: lax.pcast(a, (stage_axis,), to="varying")
+    carry0 = (mark(jnp.zeros_like(xs[0])), mark(jnp.zeros_like(xs)))
+    (_, outs), _ = lax.scan(tick, carry0, jnp.arange(ticks))
+    # finished activations live on the last stage; replicate them around
+    # the ring so the (replicated) head runs everywhere
+    outs = lax.psum(jnp.where(idx == n_stages - 1, outs, 0.0), stage_axis)
+    return outs.reshape(b, *x.shape[1:])
+
+
+def pipelined_lm_apply(mesh, params, tokens, *, n_heads: int,
+                       n_micro: int = 4, stage_axis: str = MODEL_AXIS,
+                       mlp_ratio: int = 4, dtype=jnp.float32):
+    """Forward logits through the dp x pp mesh (jit-compatible)."""
+    d_model = params["norm_scale"].shape[0]
+    block = TransformerBlock(d_model, n_heads, mlp_ratio, dtype)
+
+    def fn(p, t):
+        x = _embed(p, t).astype(dtype)
+        x = _pipeline_blocks(block, p["blocks"], x, stage_axis, n_micro)
+        return _head(p, x.astype(jnp.float32))
+
+    blocks_spec = jax.tree_util.tree_map(
+        lambda _: P(stage_axis), params["blocks"])
+    in_spec = {**{k: P() for k in params}, "blocks": blocks_spec}
+    return _shard_map(fn, mesh=mesh,
+                      in_specs=(in_spec, P(DATA_AXIS)),
+                      out_specs=P(DATA_AXIS))(params, tokens)
+
+
+def sequential_lm_apply(params, tokens, *, n_heads: int, mlp_ratio: int = 4,
+                        dtype=jnp.float32):
+    """Single-device reference: same params, plain sequential block stack
+    (the parity oracle for the pipeline schedule)."""
+    d_model = params["norm_scale"].shape[0]
+    block = TransformerBlock(d_model, n_heads, mlp_ratio, dtype)
+    x = _embed(params, tokens).astype(dtype)
+    x = _apply_stage(block, params["blocks"], x)
+    return _head(params, x.astype(jnp.float32))
+
+
+def pipeline_param_shardings(mesh, params, stage_axis: str = MODEL_AXIS):
+    """NamedShardings placing each leaf where the pipeline uses it:
+    stacked block layers split over the stage axis, the rest replicated."""
+    blocks = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P(stage_axis)), params["blocks"])
+    return {**{k: NamedSharding(mesh, P()) for k in params
+               if k != "blocks"}, "blocks": blocks}
+
+
+def make_pipeline_lm_step(mesh, tx, *, n_heads: int, n_micro: int = 4,
+                          stage_axis: str = MODEL_AXIS,
+                          aux_weight: float = 0.0, mlp_ratio: int = 4,
+                          dtype=jnp.float32):
+    """Jitted (params, opt_state, tokens, targets) -> (params, opt, loss)
+    train step through the pipeline (dp over 'data', pp over the stage
+    axis); gradients flow through the reverse pipeline automatically."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, targets):
+        def loss_fn(p):
+            logits = pipelined_lm_apply(
+                mesh, p, tokens, n_heads=n_heads, n_micro=n_micro,
+                stage_axis=stage_axis, mlp_ratio=mlp_ratio, dtype=dtype)
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.take_along_axis(lp, targets[..., None], -1).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def count_pipeline_bubble(n_micro: int, n_stages: int) -> float:
+    """Idle fraction of the GPipe schedule: (S-1)/(M+S-1)."""
+    return (n_stages - 1) / (n_micro + n_stages - 1)

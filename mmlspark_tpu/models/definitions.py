@@ -279,6 +279,9 @@ class TransformerLM(nn.Module, NodeMixin):
     mlp_impl: str = "dense"            # dense | moe (Switch top-1 experts)
     n_experts: int = 8
     expert_axis: Optional[str] = None  # mesh axis for expert parallelism
+    remat: bool = False  # rematerialize each block's activations in the
+    # backward (jax.checkpoint): trades ~1 extra forward of FLOPs for
+    # O(n_layers) less activation HBM — the long-context training lever
 
     @nn.compact
     def __call__(self, tokens):
@@ -294,8 +297,10 @@ class TransformerLM(nn.Module, NodeMixin):
         pos_emb = nn.Embed(self.max_len, self.d_model,
                            dtype=self.dtype, name="pos_embed")(pos)
         x = self.node("embed", tok_emb + pos_emb[None])
+        block_cls = nn.remat(TransformerBlock) if self.remat \
+            else TransformerBlock
         for i in range(self.n_layers):
-            x = TransformerBlock(
+            x = block_cls(
                 self.d_model, self.n_heads, self.mlp_ratio, self.dtype,
                 self.attn_impl, self.seq_axis, self.mlp_impl,
                 self.n_experts, self.expert_axis, name=f"block{i}_w")(x)

@@ -130,3 +130,29 @@ def test_tpu_model_requires_bundle():
     t = DataTable({"x": np.zeros((2, 3), np.float32)})
     with pytest.raises(ValueError):
         TPUModel(inputCol="x").transform(t)
+
+
+def test_transformer_lm_remat_matches_non_remat():
+    """remat=True changes memory scheduling, never values: forward AND
+    gradients must match the plain model exactly (same params)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.definitions import build_model
+
+    cfg = {"vocab_size": 32, "d_model": 32, "n_heads": 4, "n_layers": 2,
+           "max_len": 16, "dtype": "float32"}
+    plain = build_model("TransformerLM", cfg)
+    remat = build_model("TransformerLM", {**cfg, "remat": True})
+    toks = jnp.asarray(np.arange(32).reshape(2, 16) % 32, jnp.int32)
+    params = plain.init(jax.random.key(0), toks)
+    np.testing.assert_allclose(np.asarray(plain.apply(params, toks)),
+                               np.asarray(remat.apply(params, toks)),
+                               rtol=1e-6, atol=1e-6)
+    loss = lambda m: lambda p: jnp.sum(m.apply(p, toks).astype(jnp.float32) ** 2)
+    g_plain = jax.grad(loss(plain))(params)
+    g_remat = jax.grad(loss(remat))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_plain),
+                    jax.tree_util.tree_leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

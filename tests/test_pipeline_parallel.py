@@ -47,8 +47,9 @@ def test_pipeline_matches_sequential(setup, pp_mesh, n_micro):
                                rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.budget(60)  # differentiating shard_map+scan is a fixed
-# ~35s XLA compile on the CPU mesh regardless of model size
+@pytest.mark.budget(120)  # differentiating shard_map+scan is a fixed
+# ~35-85s XLA compile on the CPU mesh (load-sensitive), regardless of
+# model size
 def test_pipeline_gradients_match_sequential(setup):
     """The autodiff-derived reverse pipeline (transposed ppermutes) must
     produce the same gradients as the sequential reference.  A 2-stage

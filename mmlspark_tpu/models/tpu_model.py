@@ -110,10 +110,12 @@ class TPUModel(Transformer):
         module = self._bundle.module()
 
         def forward(vars_, x):
-            # integer inputs (uint8 images) travel the host->HBM link at 1/4
-            # the bytes of float32 and are cast on device — the transfer link
-            # is the scoring bottleneck, not the MXU
-            if not jnp.issubdtype(x.dtype, jnp.floating):
+            # uint8 inputs (decoded image bytes) travel the host->HBM link
+            # at 1/4 the bytes of float32 and are cast on device — the
+            # transfer link is the scoring bottleneck, not the MXU.  Wider
+            # integer dtypes are NOT cast: they are token ids (TransformerLM
+            # and friends embed them; a float cast would break Embed)
+            if x.dtype == jnp.uint8:
                 x = x.astype(jnp.float32)
             out, state = module.apply(vars_, x, mutable=["intermediates"])
             inter = state.get("intermediates", {})
@@ -155,8 +157,14 @@ class TPUModel(Transformer):
     @staticmethod
     def _tensor_column(col: np.ndarray) -> np.ndarray:
         if col.dtype == object:
-            return (np.stack([np.asarray(v, np.float32) for v in col])
-                    if len(col) else np.zeros((0, 1), np.float32))
+            if not len(col):
+                return np.zeros((0, 1), np.float32)
+            stacked = np.stack([np.asarray(v) for v in col])
+            # integer rows stay integer (token ids feeding Embed layers);
+            # everything else normalizes to float32 as before
+            if np.issubdtype(stacked.dtype, np.integer):
+                return stacked
+            return stacked.astype(np.float32)
         return col
 
     # -- transform ------------------------------------------------------

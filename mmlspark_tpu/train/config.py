@@ -51,6 +51,10 @@ class TrainerConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every_steps: int = 0        # 0 = only at end
 
+    # weight on model-sown auxiliary losses (flax "losses" collection,
+    # e.g. the MoE load-balance term); 0 ignores the sown values
+    aux_loss_weight: float = 0.0
+
     def __post_init__(self):
         if self.loss not in LOSSES:
             raise ValueError(f"loss must be one of {LOSSES}, got {self.loss!r}")

@@ -153,13 +153,14 @@ class Trainer:
 
     # -- state ----------------------------------------------------------
     def init_state(self, input_shape: tuple, total_steps: int = 1,
-                   initial_bundle: Optional[ModelBundle] = None) -> TrainState:
+                   initial_bundle: Optional[ModelBundle] = None,
+                   input_dtype=np.float32) -> TrainState:
         """Initialize (or warm-start, for fine-tuning) the sharded TrainState."""
         self._tx = self._build_optimizer(total_steps)
         if initial_bundle is not None:
             variables = _to_plain(initial_bundle.variables)
         else:
-            x = np.zeros(input_shape, np.float32)
+            x = np.zeros(input_shape, input_dtype)
             variables = _to_plain(
                 self.module.init(jax.random.key(self.config.seed), x))
         params = variables["params"]
@@ -188,19 +189,30 @@ class Trainer:
         has_train = self._has_train_arg
         tx = self._tx
 
+        aux_w = float(self.config.aux_loss_weight)
+
         def train_step(state: TrainState, x, y, mask):
             def compute(params):
                 variables = {"params": params}
                 if state.batch_stats:
                     variables["batch_stats"] = state.batch_stats
                 if has_train:
-                    out, mut = module.apply(variables, x, train=True,
-                                            mutable=["batch_stats"])
+                    out, mut = module.apply(
+                        variables, x, train=True,
+                        mutable=["batch_stats", "losses"])
                     new_stats = mut.get("batch_stats", state.batch_stats)
                 else:
-                    out = module.apply(variables, x)
+                    out, mut = module.apply(variables, x,
+                                            mutable=["losses"])
                     new_stats = state.batch_stats
-                return loss_fn(out, y, mask), new_stats
+                loss = loss_fn(out, y, mask)
+                if aux_w:
+                    # model-sown auxiliary losses (e.g. the MoE
+                    # load-balance term, ops/moe.py) join the objective
+                    loss = loss + aux_w * sum(
+                        jnp.asarray(v).sum() for v in
+                        jax.tree_util.tree_leaves(mut.get("losses", {})))
+                return loss, new_stats
 
             (loss, new_stats), grads = jax.value_and_grad(
                 compute, has_aux=True)(state.params)
@@ -267,7 +279,9 @@ class Trainer:
         steps_per_epoch = max(1, (n + bs_local - 1) // bs_local)
         total_steps = steps_per_epoch * cfg.epochs
 
-        state = self.init_state((1,) + x.shape[1:], total_steps, initial_bundle)
+        state = self.init_state((1,) + x.shape[1:], total_steps,
+                                initial_bundle,
+                                input_dtype=np.asarray(x).dtype)
         step_fn = self.make_train_step()
         x_sh = batch_sharding(self.mesh)
 

@@ -156,3 +156,26 @@ def test_transformer_lm_remat_matches_non_remat():
                     jax.tree_util.tree_leaves(g_remat)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_tpu_model_scores_token_models():
+    """TPUModel must pass integer token columns through uncast (Embed
+    requires ints; only uint8 image bytes get the on-device float cast)."""
+    import jax
+
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.models import ModelBundle, TPUModel
+    from mmlspark_tpu.models.definitions import build_model
+
+    lm = build_model("TransformerLM", {
+        "vocab_size": 16, "d_model": 16, "n_heads": 2, "n_layers": 1,
+        "max_len": 8, "dtype": "float32"})
+    toks = (np.arange(40).reshape(5, 8) % 16).astype(np.int32)
+    bundle = ModelBundle.from_module(
+        lm, jax.tree_util.tree_map(
+            np.asarray, lm.init(jax.random.key(0), toks)))
+    scored = TPUModel(bundle, inputCol="tokens", outputCol="logits",
+                      miniBatchSize=4).transform(DataTable({"tokens": toks}))
+    assert scored["logits"].shape == (5, 8, 16)
+    ref = np.asarray(lm.apply(bundle.variables, toks))
+    np.testing.assert_allclose(scored["logits"], ref, rtol=1e-5, atol=1e-5)

@@ -94,6 +94,8 @@ def test_download_unknown_model(tmp_path, source_repo):
         dl.download_by_name(source_repo, "DoesNotExist")
 
 
+@pytest.mark.budget(60)  # materializes + packs several real nets
+# (ResNet init dominates); ~25-35s, load-sensitive
 def test_builtin_repo(tmp_path):
     include = ["ConvNet", "ResNet18", "MLP"]
     repo = create_builtin_repo(str(tmp_path / "zoo"), include=include)

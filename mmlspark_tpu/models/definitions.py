@@ -205,6 +205,8 @@ class TransformerBlock(nn.Module):
     mlp_impl: str = "dense"           # dense | moe
     n_experts: int = 8                # experts when mlp_impl == "moe"
     expert_axis: Optional[str] = None  # mesh axis experts shard over (EP)
+    moe_router_k: int = 1             # 1 = Switch top-1, 2 = GShard top-2
+    moe_group_size: int = 512         # routing group (bounds dispatch memory)
 
     @nn.compact
     def __call__(self, x):
@@ -247,7 +249,10 @@ class TransformerBlock(nn.Module):
             from mmlspark_tpu.ops.moe import MoEMLP
             return x + MoEMLP(self.d_model, n_experts=self.n_experts,
                               mlp_ratio=self.mlp_ratio, dtype=self.dtype,
-                              expert_axis=self.expert_axis, name="moe")(h)
+                              expert_axis=self.expert_axis,
+                              router_k=self.moe_router_k,
+                              group_size=self.moe_group_size,
+                              name="moe")(h)
         h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype,
                      name="mlp_up")(h)
         h = nn.gelu(h)
@@ -276,9 +281,11 @@ class TransformerLM(nn.Module, NodeMixin):
     dtype: Dtype = jnp.bfloat16
     attn_impl: str = "dense"
     seq_axis: Optional[str] = None
-    mlp_impl: str = "dense"            # dense | moe (Switch top-1 experts)
+    mlp_impl: str = "dense"            # dense | moe (Switch/GShard experts)
     n_experts: int = 8
     expert_axis: Optional[str] = None  # mesh axis for expert parallelism
+    moe_router_k: int = 1              # top-k routing (1=Switch, 2=GShard)
+    moe_group_size: int = 512          # routing group size (memory bound)
     remat: bool = False  # rematerialize each block's activations in the
     # backward (jax.checkpoint): trades ~1 extra forward of FLOPs for
     # O(n_layers) less activation HBM — the long-context training lever
@@ -303,7 +310,8 @@ class TransformerLM(nn.Module, NodeMixin):
             x = block_cls(
                 self.d_model, self.n_heads, self.mlp_ratio, self.dtype,
                 self.attn_impl, self.seq_axis, self.mlp_impl,
-                self.n_experts, self.expert_axis, name=f"block{i}_w")(x)
+                self.n_experts, self.expert_axis, self.moe_router_k,
+                self.moe_group_size, name=f"block{i}_w")(x)
             x = self.node(f"block{i}", x)
         x = nn.LayerNorm(dtype=self.dtype, name="final_norm_w")(x)
         x = self.node("final_norm", x)

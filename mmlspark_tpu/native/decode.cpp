@@ -9,6 +9,7 @@
 // Exposed as a plain C ABI consumed via ctypes (the NativeLoader-equivalent
 // lives in mmlspark_tpu/native_loader.py, cf. NativeLoader.java:29-159).
 
+#include <cstdint>
 #include <atomic>
 #include <csetjmp>
 #include <cstdio>
@@ -33,11 +34,11 @@ void jpeg_error_exit(j_common_ptr cinfo) {
 
 void jpeg_silence(j_common_ptr, int) {}
 
-bool is_jpeg(const unsigned char* buf, long len) {
+bool is_jpeg(const unsigned char* buf, int64_t len) {
   return len >= 3 && buf[0] == 0xFF && buf[1] == 0xD8 && buf[2] == 0xFF;
 }
 
-bool is_png(const unsigned char* buf, long len) {
+bool is_png(const unsigned char* buf, int64_t len) {
   return len >= 8 && png_sig_cmp(buf, 0, 8) == 0;
 }
 
@@ -47,7 +48,7 @@ extern "C" {
 
 // Probe dimensions. Returns 0 on success, fills (width, height, channels);
 // channels is what decode_image will produce (3 = BGR, 1 = gray).
-int image_dims(const unsigned char* buf, long len, int* width, int* height,
+int image_dims(const unsigned char* buf, int64_t len, int* width, int* height,
                int* channels) {
   if (is_jpeg(buf, len)) {
     jpeg_decompress_struct cinfo;
@@ -61,7 +62,7 @@ int image_dims(const unsigned char* buf, long len, int* width, int* height,
     }
     jpeg_create_decompress(&cinfo);
     jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
-                 static_cast<unsigned long>(len));
+                 static_cast<uint64_t>(len));
     jpeg_read_header(&cinfo, TRUE);
     *width = static_cast<int>(cinfo.image_width);
     *height = static_cast<int>(cinfo.image_height);
@@ -88,7 +89,7 @@ int image_dims(const unsigned char* buf, long len, int* width, int* height,
 
 // Decode into caller-allocated out (height*width*channels bytes, BGR or
 // gray row-major). Returns 0 on success.
-int decode_image(const unsigned char* buf, long len, unsigned char* out,
+int decode_image(const unsigned char* buf, int64_t len, unsigned char* out,
                  int width, int height, int channels) {
   if (is_jpeg(buf, len)) {
     jpeg_decompress_struct cinfo;
@@ -102,7 +103,7 @@ int decode_image(const unsigned char* buf, long len, unsigned char* out,
     }
     jpeg_create_decompress(&cinfo);
     jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
-                 static_cast<unsigned long>(len));
+                 static_cast<uint64_t>(len));
     jpeg_read_header(&cinfo, TRUE);
     cinfo.out_color_space = channels == 1 ? JCS_GRAYSCALE : JCS_RGB;
     jpeg_start_decompress(&cinfo);
@@ -114,14 +115,14 @@ int decode_image(const unsigned char* buf, long len, unsigned char* out,
     const int row_bytes = width * channels;
     while (cinfo.output_scanline < cinfo.output_height) {
       unsigned char* row = out +
-          static_cast<long>(cinfo.output_scanline) * row_bytes;
+          static_cast<int64_t>(cinfo.output_scanline) * row_bytes;
       jpeg_read_scanlines(&cinfo, &row, 1);
     }
     jpeg_finish_decompress(&cinfo);
     jpeg_destroy_decompress(&cinfo);
     if (channels == 3) {  // RGB -> BGR in place
-      const long n = static_cast<long>(width) * height;
-      for (long i = 0; i < n; ++i) {
+      const int64_t n = static_cast<int64_t>(width) * height;
+      for (int64_t i = 0; i < n; ++i) {
         unsigned char t = out[i * 3];
         out[i * 3] = out[i * 3 + 2];
         out[i * 3 + 2] = t;
@@ -163,7 +164,7 @@ int decode_image(const unsigned char* buf, long len, unsigned char* out,
 // heights[i]*widths[i]*channels[i] bytes (probe with image_dims first).
 // status[i] receives each image's decode_image return code; the function
 // returns the number of failures.
-int decode_batch(const unsigned char** bufs, const long* lens,
+int decode_batch(const unsigned char** bufs, const int64_t* lens,
                  unsigned char** outs, const int* widths, const int* heights,
                  const int* channels, int n, int n_threads, int* status) {
   if (n <= 0) return 0;

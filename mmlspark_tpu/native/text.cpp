@@ -24,6 +24,8 @@
 
 #include <zlib.h>
 
+#include <cstdint>
+
 #include <algorithm>
 #include <cstdlib>
 #include <cstring>
@@ -50,23 +52,23 @@ extern "C" {
 // text_hash_free: slots/vals hold the concatenated per-doc sorted unique
 // (slot, count) pairs, bounds is an (n_docs+1) prefix, status[i] is 1 when
 // doc i contained non-ASCII bytes and was skipped (bounds stay flat there).
-int text_hash_count(const char* buf, const long* offsets, long n_docs,
-                    const char* stop_buf, const long* stop_offsets,
-                    long n_stop, int lowercase, int lower_for_stop,
-                    long min_token_len, long num_features, int binary,
-                    int** out_slots, float** out_vals, long** out_bounds,
+int text_hash_count(const char* buf, const int64_t* offsets, int64_t n_docs,
+                    const char* stop_buf, const int64_t* stop_offsets,
+                    int64_t n_stop, int lowercase, int lower_for_stop,
+                    int64_t min_token_len, int64_t num_features, int binary,
+                    int** out_slots, float** out_vals, int64_t** out_bounds,
                     unsigned char** out_status) {
     if (num_features <= 0) return 1;
     std::unordered_set<std::string> stop;
     stop.reserve(static_cast<size_t>(n_stop) * 2);
-    for (long i = 0; i < n_stop; ++i)
+    for (int64_t i = 0; i < n_stop; ++i)
         stop.emplace(stop_buf + stop_offsets[i],
                      static_cast<size_t>(stop_offsets[i + 1] -
                                          stop_offsets[i]));
 
     std::vector<int> slots;
     std::vector<float> vals;
-    std::vector<long> bounds(1, 0);
+    std::vector<int64_t> bounds(1, 0);
     bounds.reserve(static_cast<size_t>(n_docs) + 1);
     unsigned char* status = static_cast<unsigned char*>(
         std::malloc(n_docs ? static_cast<size_t>(n_docs) : 1));
@@ -74,11 +76,11 @@ int text_hash_count(const char* buf, const long* offsets, long n_docs,
 
     std::string token, lowered;
     std::vector<unsigned int> doc_slots;
-    for (long d = 0; d < n_docs; ++d) {
+    for (int64_t d = 0; d < n_docs; ++d) {
         const char* p = buf + offsets[d];
-        const long len = offsets[d + 1] - offsets[d];
+        const int64_t len = offsets[d + 1] - offsets[d];
         status[d] = 0;
-        for (long i = 0; i < len; ++i) {
+        for (int64_t i = 0; i < len; ++i) {
             if (static_cast<unsigned char>(p[i]) >= 0x80) {
                 status[d] = 1;  // non-ASCII: Python recomputes this row
                 break;
@@ -86,14 +88,14 @@ int text_hash_count(const char* buf, const long* offsets, long n_docs,
         }
         doc_slots.clear();
         if (!status[d]) {
-            long i = 0;
+            int64_t i = 0;
             while (i < len) {
                 while (i < len && is_ws(static_cast<unsigned char>(p[i])))
                     ++i;
-                long start = i;
+                int64_t start = i;
                 while (i < len && !is_ws(static_cast<unsigned char>(p[i])))
                     ++i;
-                const long tlen = i - start;
+                const int64_t tlen = i - start;
                 if (tlen == 0 || tlen < min_token_len) continue;
                 token.assign(p + start, static_cast<size_t>(tlen));
                 if (lowercase)
@@ -111,8 +113,8 @@ int text_hash_count(const char* buf, const long* offsets, long n_docs,
                     0L, reinterpret_cast<const Bytef*>(token.data()),
                     static_cast<uInt>(token.size()));
                 doc_slots.push_back(static_cast<unsigned int>(
-                    static_cast<unsigned long>(h) %
-                    static_cast<unsigned long>(num_features)));
+                    static_cast<uint64_t>(h) %
+                    static_cast<uint64_t>(num_features)));
             }
             std::sort(doc_slots.begin(), doc_slots.end());
             for (size_t j = 0; j < doc_slots.size();) {
@@ -125,14 +127,14 @@ int text_hash_count(const char* buf, const long* offsets, long n_docs,
                 j = k;
             }
         }
-        bounds.push_back(static_cast<long>(slots.size()));
+        bounds.push_back(static_cast<int64_t>(slots.size()));
     }
 
     const size_t n_out = slots.size();
     int* s_out = static_cast<int*>(std::malloc(n_out ? n_out * 4 : 4));
     float* v_out = static_cast<float*>(std::malloc(n_out ? n_out * 4 : 4));
-    long* b_out = static_cast<long*>(
-        std::malloc(bounds.size() * sizeof(long)));
+    int64_t* b_out = static_cast<int64_t*>(
+        std::malloc(bounds.size() * sizeof(int64_t)));
     if (!s_out || !v_out || !b_out) {
         std::free(s_out); std::free(v_out); std::free(b_out);
         std::free(status);
@@ -142,7 +144,7 @@ int text_hash_count(const char* buf, const long* offsets, long n_docs,
         std::memcpy(s_out, slots.data(), n_out * 4);
         std::memcpy(v_out, vals.data(), n_out * 4);
     }
-    std::memcpy(b_out, bounds.data(), bounds.size() * sizeof(long));
+    std::memcpy(b_out, bounds.data(), bounds.size() * sizeof(int64_t));
     *out_slots = s_out;
     *out_vals = v_out;
     *out_bounds = b_out;
@@ -150,7 +152,7 @@ int text_hash_count(const char* buf, const long* offsets, long n_docs,
     return 0;
 }
 
-void text_hash_free(int* slots, float* vals, long* bounds,
+void text_hash_free(int* slots, float* vals, int64_t* bounds,
                     unsigned char* status) {
     std::free(slots);
     std::free(vals);

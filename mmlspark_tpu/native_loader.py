@@ -71,38 +71,38 @@ def get_native_lib() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(build_native())
             lib.image_dims.restype = ctypes.c_int
             lib.image_dims.argtypes = [
-                ctypes.c_char_p, ctypes.c_long,
+                ctypes.c_char_p, ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
                 ctypes.POINTER(ctypes.c_int)]
             lib.decode_image.restype = ctypes.c_int
             lib.decode_image.argtypes = [
-                ctypes.c_char_p, ctypes.c_long, ctypes.c_void_p,
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p,
                 ctypes.c_int, ctypes.c_int, ctypes.c_int]
             lib.decode_batch.restype = ctypes.c_int
             lib.decode_batch.argtypes = [
                 ctypes.POINTER(ctypes.c_char_p),
-                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_void_p),
                 ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
                 ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_int)]
             lib.text_hash_count.restype = ctypes.c_int
             lib.text_hash_count.argtypes = [
-                ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
-                ctypes.c_long,
-                ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
-                ctypes.c_long,
-                ctypes.c_int, ctypes.c_int, ctypes.c_long, ctypes.c_long,
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_int,
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_int)),
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
-                ctypes.POINTER(ctypes.POINTER(ctypes.c_long)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte))]
             lib.text_hash_free.restype = None
             lib.text_hash_free.argtypes = [
                 ctypes.POINTER(ctypes.c_int),
                 ctypes.POINTER(ctypes.c_float),
-                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_ubyte)]
             _lib = lib
         except Exception:
@@ -165,7 +165,7 @@ def native_decode_batch(buffers: list) -> Optional[list]:
     m = len(idx)
     outs = [np.empty((hh, ww, cc), np.uint8) for (ww, hh, cc) in dims]
     buf_arr = (ctypes.c_char_p * m)(*[buffers[i] for i in idx])
-    len_arr = (ctypes.c_long * m)(*[len(buffers[i]) for i in idx])
+    len_arr = (ctypes.c_int64 * m)(*[len(buffers[i]) for i in idx])
     out_arr = (ctypes.c_void_p * m)(
         *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs])
     w_arr = (ctypes.c_int * m)(*[d[0] for d in dims])
@@ -206,12 +206,12 @@ def native_text_hash(docs: list, stopwords: list, lowercase: bool,
 
     slots_p = ctypes.POINTER(ctypes.c_int)()
     vals_p = ctypes.POINTER(ctypes.c_float)()
-    bounds_p = ctypes.POINTER(ctypes.c_long)()
+    bounds_p = ctypes.POINTER(ctypes.c_int64)()
     status_p = ctypes.POINTER(ctypes.c_ubyte)()
     rc = lib.text_hash_count(
-        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         len(enc),
-        sbuf, soff.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), len(senc),
+        sbuf, soff.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(senc),
         int(lowercase), int(lower_for_stop), int(min_token_len),
         int(num_features), int(binary),
         ctypes.byref(slots_p), ctypes.byref(vals_p), ctypes.byref(bounds_p),

@@ -356,11 +356,12 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    # the lse residual rides as rank-3 (bh, sq, 1) inside the kernels:
-    # real TPU needs a sublane-multiple block_q there (interpret mode does
-    # not); without it the backward will be the dense VJP, so don't pay
-    # for lse in the forward
-    if interpret or block_q % 8 == 0:
+    # the lse residual rides as rank-3 (bh, sq, 1) inside the kernels and
+    # the backward's dK/dV output blocks are (1, block_k, d): real TPU
+    # needs sublane-multiple block_q AND block_k there (interpret mode
+    # does not); without them the backward will be the dense VJP, so
+    # don't pay for lse in the forward
+    if interpret or (block_q % 8 == 0 and block_k % 8 == 0):
         out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
                                   interpret, with_lse=True)
         return out, (q, k, v, out, lse)
@@ -472,10 +473,11 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
             "sequence lengths do not tile the blocks (pad the sequence or "
             "adjust block sizes)")
         return _dense_with_lse(q, k, v, causal, scale_, q_offset, k_offset)
-    if not interpret and block_q % 8:
+    if not interpret and (block_q % 8 or block_k % 8):
         _warn_dense_fallback(
             "flash_attention_with_lse", sq, sk, block_q, block_k, interpret,
-            "the lse output needs a sublane-multiple block_q (8) on TPU")
+            "the lse output / (1, block_k, d) K-V blocks need "
+            "sublane-multiple block_q and block_k (8) on TPU")
         return _dense_with_lse(q, k, v, causal, scale_, q_offset, k_offset)
     if interpret and in_manual_region:
         return _dense_with_lse(q, k, v, causal, scale_, q_offset, k_offset)
@@ -533,11 +535,11 @@ def flash_block_grads(q, k, v, do, lse, delta, causal: bool, scale: float,
             "adjust block sizes)")
         return _dense_block_grads(q, k, v, do, lse, delta, causal, scale,
                                   q_offset, k_offset)
-    if not interpret and block_q % 8:
+    if not interpret and (block_q % 8 or block_k % 8):
         _warn_dense_fallback(
             "flash_block_grads", sq, sk, block_q, block_k, interpret,
-            "the lse/delta operands need a sublane-multiple block_q (8) on "
-            "TPU")
+            "the lse/delta operands and (1, block_k, d) dK/dV blocks need "
+            "sublane-multiple block_q and block_k (8) on TPU")
         return _dense_block_grads(q, k, v, do, lse, delta, causal, scale,
                                   q_offset, k_offset)
     if interpret and _in_manual_region(q):

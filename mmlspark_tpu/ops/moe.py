@@ -1,22 +1,39 @@
 """Mixture-of-Experts with expert parallelism (EP) over a mesh axis.
 
 New-design headroom over the reference (which has no sparse/conditional
-compute at all — SURVEY §2b): a Switch-style top-1 MoE MLP.  Expert
+compute at all — SURVEY §2b): a Switch-style top-k MoE MLP.  Expert
 parallelism follows the GSPMD recipe rather than hand-written collectives:
 the stacked expert weights (E, D, H) are sharded over a mesh axis
-(`expert_parallel_rules`), the dispatched slot tensor (E, C, D) carries a
-matching sharding constraint, and XLA inserts the all_to_all / all_gather
-traffic — the "annotate shardings, let the compiler place collectives"
-discipline the rest of the framework uses for TP/DP.
+(`expert_parallel_rules`), the dispatched slot tensor carries a matching
+sharding constraint, and XLA inserts the all_to_all / all_gather traffic —
+the "annotate shardings, let the compiler place collectives" discipline the
+rest of the framework uses for TP/DP.
 
 Design for XLA: everything is static-shape.  Routing uses the classic
 dispatch/combine one-hot formulation (einsum-only — no gather/scatter, no
-dynamic shapes), with a fixed per-expert capacity
-`C = ceil(T / E * capacity_factor)`; tokens beyond an expert's capacity
-are dropped (their residual stream passes through unchanged), exactly the
-Switch Transformer discipline.  The load-balance auxiliary loss
-`E * Σ_e f_e · p_e` is sown into the `"losses"` collection for training
-loops to add (weighted) to the objective.
+dynamic shapes) applied PER TOKEN GROUP, the Mesh-TF/GShard convention:
+tokens are split into fixed groups of at most `group_size`, each group
+routes independently with per-expert capacity
+`C = ceil(G / E * capacity_factor * k)`, and tokens beyond an expert's
+capacity within their group are dropped (their residual stream passes
+through unchanged).  Grouping bounds the dispatch/combine tensors at
+~`capacity_factor * k * T * group_size` float32 elements — LINEAR in the
+token count T, where ungrouped routing would cost
+`capacity_factor * T^2` (multiple GB per layer at long-context scale).
+
+Observability: the router sows three values —
+
+  * `"losses" / "moe_aux_loss"`: the Switch load-balance term
+    `E * Σ_e f_e · p_e` (f = choice-1 dispatch frequency, p = mean router
+    probability), to be weighted into the objective
+    (TrainerConfig.aux_loss_weight);
+  * `"losses" / "moe_z_loss"`: the router z-loss
+    `z_loss_weight * mean(logsumexp(logits)^2)` — PRE-SCALED by
+    `z_loss_weight` so the trainer's single aux_loss_weight knob applies
+    to the sum of sown losses;
+  * `"metrics" / "moe_overflow_fraction"`: the fraction of routing slots
+    dropped by capacity this step, so capacity collapse is visible in
+    training history instead of silently degrading quality.
 """
 
 from __future__ import annotations
@@ -31,40 +48,88 @@ from flax import linen as nn
 from mmlspark_tpu.parallel.mesh import MODEL_AXIS
 
 
-def top1_dispatch(router_logits: jax.Array, capacity: int):
-    """(dispatch (T,E,C), combine (T,E,C), aux_loss) from router logits.
+def topk_dispatch(router_logits: jax.Array, capacity: int, k: int = 1):
+    """(dispatch (T,E,C), combine (T,E,C), aux_loss, z_loss, kept_fraction)
+    from one group's router logits (T, E).
 
     float32 routing throughout (softmax statistics must not ride bf16).
-    `dispatch` places each kept token in its expert's next free slot;
-    `combine` additionally scales by the router gate, so
-    `y = combine^T · expert(dispatch · x)` is the Switch forward.
+    All j-th choices queue behind every (j-1)-th choice in an expert's
+    capacity buffer (the GShard priority rule); within a choice, slots
+    fill in token order (the deterministic Switch tie-break).  `combine`
+    scales by the router gate — raw for k=1 (Switch), normalized over the
+    k chosen gates for k>1 (GShard) — so
+    `y = combine^T · expert(dispatch · x)` is the MoE forward.
     """
     t, e = router_logits.shape
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)                   # (T,)
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], 1)[:, 0]
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T,E)
-    # position of each token within its expert's queue (first-come order,
-    # the deterministic Switch tie-break)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # (T,E)
-    within = (pos < capacity) & (pos >= 0)
-    pos_oh = jax.nn.one_hot(pos.max(axis=-1).astype(jnp.int32), capacity,
-                            dtype=jnp.float32)                 # (T,C)
-    dispatch = (onehot * within)[:, :, None] * pos_oh[:, None, :]
-    combine = dispatch * gate[:, None, None]
-    f = onehot.mean(axis=0)                                    # (E,)
+    logits32 = router_logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits32, axis=-1)
+
+    remaining = probs
+    counts = jnp.zeros((e,), jnp.float32)    # slots consumed per expert
+    parts = []                               # (onehot, gate, pos_value)
+    for _ in range(k):
+        expert_idx = jnp.argmax(remaining, axis=-1)            # (T,)
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        gate = jnp.take_along_axis(probs, expert_idx[:, None], 1)[:, 0]
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + counts[None, :])
+        pos_val = (pos * onehot).sum(-1)                       # (T,)
+        parts.append((onehot, gate, pos_val))
+        counts = counts + onehot.sum(0)
+        remaining = remaining * (1.0 - onehot)  # mask chosen for next choice
+
+    if k > 1:
+        denom = sum(g for _, g, _ in parts) + 1e-9
+        parts = [(oh, g / denom, pv) for (oh, g, pv) in parts]
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    kept_slots = 0.0
+    for onehot, gate, pos_val in parts:
+        within = (pos_val < capacity) & (pos_val >= 0)
+        pos_oh = jax.nn.one_hot(pos_val.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)
+        d_j = (onehot * within[:, None])[:, :, None] * pos_oh[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gate[:, None, None]
+        kept_slots = kept_slots + d_j.sum()
+
+    # load balance on choice-1 frequencies (the Switch definition)
+    f = parts[0][0].mean(axis=0)
     p = probs.mean(axis=0)
     aux = e * jnp.sum(f * p)
+    z = jnp.mean(jax.scipy.special.logsumexp(logits32, axis=-1) ** 2)
+    kept_fraction = kept_slots / float(t * k)
+    return dispatch, combine, aux, z, kept_fraction
+
+
+def top1_dispatch(router_logits: jax.Array, capacity: int):
+    """(dispatch (T,E,C), combine (T,E,C), aux_loss): the Switch top-1
+    special case of `topk_dispatch` (kept as the stable one-group API)."""
+    dispatch, combine, aux, _, _ = topk_dispatch(router_logits, capacity, 1)
     return dispatch, combine, aux
 
 
+def _group_size(t: int, target: int) -> int:
+    """Largest divisor of t that is <= target (static Python arithmetic —
+    shapes stay known to XLA)."""
+    target = max(1, min(t, target))
+    for g in range(target, 0, -1):
+        if t % g == 0:
+            return g
+    return 1
+
+
 class MoEMLP(nn.Module):
-    """Drop-in MLP replacement: router -> top-1 experts -> combine.
+    """Drop-in MLP replacement: router -> top-k experts -> combine.
 
     `expert_axis` names the mesh axis the (E, ...) tensors shard over; it
     only places a `with_sharding_constraint` on the slot tensor (harmless
     outside jit/mesh contexts where it is a no-op on CPU tests), the
     weight shardings themselves come from `expert_parallel_rules`.
+
+    `group_size` caps the routing group (tokens route independently per
+    group, GShard-style), bounding dispatch memory at
+    ~capacity_factor * router_k * T * group_size floats.
     """
 
     d_model: int
@@ -73,28 +138,38 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
     expert_axis: Optional[str] = None
+    group_size: int = 512
+    router_k: int = 1                  # 1 = Switch, 2 = GShard top-2
+    z_loss_weight: float = 1e-3
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         b, s, d = x.shape
         t = b * s
         e = self.n_experts
+        k = self.router_k
         h = self.mlp_ratio * self.d_model
-        capacity = max(1, int(np.ceil(t / e * self.capacity_factor)))
+        gs = _group_size(t, self.group_size)
+        g = t // gs
+        capacity = max(1, int(np.ceil(gs / e * self.capacity_factor * k)))
 
         xf = x.reshape(t, d)
         logits = nn.Dense(e, dtype=jnp.float32, name="router")(
             xf.astype(jnp.float32))
-        dispatch, combine, aux = top1_dispatch(logits, capacity)
-        self.sow("losses", "moe_aux_loss", aux)
+        dispatch, combine, aux, z, kept = jax.vmap(
+            lambda lg: topk_dispatch(lg, capacity, k))(
+            logits.reshape(g, gs, e))
+        self.sow("losses", "moe_aux_loss", aux.mean())
+        self.sow("losses", "moe_z_loss", self.z_loss_weight * z.mean())
+        self.sow("metrics", "moe_overflow_fraction", 1.0 - kept.mean())
 
         w_in = self.param(
             "w_in", nn.initializers.lecun_normal(), (e, d, h), jnp.float32)
         w_out = self.param(
             "w_out", nn.initializers.lecun_normal(), (e, h, d), jnp.float32)
 
-        slots = jnp.einsum("tec,td->ecd", dispatch,
-                           xf.astype(jnp.float32)).astype(self.dtype)
+        xg = xf.reshape(g, gs, d).astype(jnp.float32)
+        slots = jnp.einsum("gtec,gtd->egcd", dispatch, xg).astype(self.dtype)
         if self.expert_axis is not None:
             try:
                 from jax.sharding import PartitionSpec as P
@@ -102,25 +177,46 @@ class MoEMLP(nn.Module):
                     slots, P(self.expert_axis))
             except (ValueError, RuntimeError):
                 pass  # no mesh in scope (eager CPU tests): constraint is moot
-        hmid = nn.relu(jnp.einsum("ecd,edh->ech", slots,
+        hmid = nn.relu(jnp.einsum("egcd,edh->egch", slots,
                                   w_in.astype(self.dtype)))
-        out = jnp.einsum("ech,ehd->ecd", hmid, w_out.astype(self.dtype))
-        y = jnp.einsum("tec,ecd->td", combine, out.astype(jnp.float32))
+        out = jnp.einsum("egch,ehd->egcd", hmid, w_out.astype(self.dtype))
+        y = jnp.einsum("gtec,egcd->gtd", combine, out.astype(jnp.float32))
         return y.astype(x.dtype).reshape(b, s, d)
+
+
+def is_expert_stack(path, shape, axis_size: int = 1) -> bool:
+    """True when a param-tree leaf at `path` with `shape` is a stacked
+    expert tensor whose leading (expert) dim can shard over an axis of
+    `axis_size` devices.  The ONE predicate shared by
+    `expert_parallel_rules` and the Trainer's sharding rule
+    (train/trainer.py::_param_sharding_rule), so placement logic cannot
+    diverge.  Scoped to leaves living under an MoE module (a path
+    component containing "moe"), not bare `w_in`/`w_out` names — an
+    unrelated module reusing those names must not get its leading dim
+    split across the mesh; and the expert count must divide the axis or
+    the leaf falls back to the caller's default placement.
+    """
+    keys = [p.key if hasattr(p, "key") else str(p) for p in path]
+    return (len(shape) == 3
+            and bool(keys) and keys[-1] in ("w_in", "w_out")
+            and any("moe" in k.lower() for k in keys[:-1])
+            and axis_size > 0 and shape[0] % axis_size == 0)
 
 
 def expert_parallel_rules(params: dict, mesh,
                           axis: str = MODEL_AXIS) -> dict:
     """NamedSharding tree for a param tree containing MoE experts: (E, ...)
-    expert tensors shard their leading (expert) dim over `axis`; everything
-    else replicates.  Feed to `jax.device_put` / `jit(in_shardings=...)` —
+    expert tensors shard their leading (expert) dim over `axis`
+    (`is_expert_stack` decides what qualifies); everything else
+    replicates.  Feed to `jax.device_put` / `jit(in_shardings=...)` —
     XLA then places the EP all_to_all traffic (GSPMD).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    axis_size = mesh.shape.get(axis, 1)
+
     def rule(path, leaf):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        if name in ("w_in", "w_out") and leaf.ndim == 3:
+        if is_expert_stack(path, leaf.shape, axis_size):
             return NamedSharding(mesh, P(axis, None, None))
         return NamedSharding(mesh, P())
 

@@ -46,6 +46,14 @@ class TrainerConfig:
     # shard dense kernels' last dim over the 'model' axis when it divides
     # evenly (simple tensor parallelism; data parallelism is always on)
     tensor_parallel: bool = True
+    # shard MoE expert stacks' leading (E, ...) dim over 'model' (expert
+    # parallelism; GSPMD places the all_to_all dispatch traffic)
+    expert_parallel: bool = True
+    # GPipe pipeline parallelism over 'model' (TransformerLM only): the
+    # block stack splits into this many stages, microbatches flow through
+    # the ring (parallel/pipeline.py); 1 = off
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 4
 
     # checkpoint/resume (the reference had none, SURVEY section 5)
     checkpoint_dir: Optional[str] = None

@@ -52,12 +52,22 @@ class TrainState:
     batch_stats: Any  # {} for stateless models
 
 
-def _param_sharding_rule(mesh, tensor_parallel: bool):
-    """Map each param leaf to a sharding: TP over 'model' for wide kernels."""
+def _param_sharding_rule(mesh, tensor_parallel: bool,
+                         expert_parallel: bool = True):
+    """Map each param leaf to a sharding: EP for MoE expert stacks (their
+    leading (E, ...) dim over 'model' — ops/moe.py expert_parallel_rules
+    folded into the product surface, so a MoE model trained through
+    Trainer gets sharded experts, not replicas), TP over 'model' for wide
+    dense kernels, replication otherwise."""
     model_size = mesh.shape.get(MODEL_AXIS, 1)
 
-    def rule(leaf: jax.ShapeDtypeStruct) -> NamedSharding:
+    from mmlspark_tpu.ops.moe import is_expert_stack
+
+    def rule(path, leaf: jax.ShapeDtypeStruct) -> NamedSharding:
         shape = leaf.shape
+        if (expert_parallel and model_size > 1
+                and is_expert_stack(path, shape, model_size)):
+            return NamedSharding(mesh, P(MODEL_AXIS, None, None))
         if (tensor_parallel and model_size > 1 and len(shape) >= 2
                 and shape[-1] % model_size == 0 and shape[-1] >= model_size * 8):
             spec = [None] * len(shape)
@@ -93,6 +103,21 @@ def _make_loss(kind: str) -> Callable:
     return loss_fn
 
 
+def _fold_metrics(metrics_tree) -> dict:
+    """Collapse a sown "metrics" collection (nested, one tuple entry per
+    sow call) to {metric_name: mean scalar} — e.g. every MoE layer's
+    overflow fraction averaged into one `moe_overflow_fraction` series.
+    Runs under jit (static structure, scalar reductions only)."""
+    grouped: dict = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(metrics_tree):
+        name = next((p.key for p in reversed(path)
+                     if hasattr(p, "key") and not str(p.key).isdigit()),
+                    "metric")
+        grouped.setdefault(str(name), []).append(
+            jnp.asarray(leaf, jnp.float32).mean())
+    return {k: jnp.stack(v).mean() for k, v in grouped.items()}
+
+
 def _epoch_order(rng, epoch: int, n: int, n_local: int,
                  shuffle: bool) -> np.ndarray:
     """The `n` local row indices this epoch feeds, drawn from `n_local`
@@ -122,6 +147,38 @@ class Trainer:
         self._has_train_arg = "train" in sig.parameters
         self._loss = _make_loss(config.loss)
         self.history: list[dict] = []
+        self._pp = config.pipeline_stages > 1
+        if self._pp:
+            self._validate_pipeline()
+
+    def _validate_pipeline(self) -> None:
+        """Pipeline parallelism preconditions, checked at construction so a
+        bad config fails fast, not at the first compiled step."""
+        cfg = self.config
+        if cfg.architecture != "TransformerLM":
+            raise ValueError(
+                "pipeline_stages > 1 supports architecture='TransformerLM' "
+                f"(got {cfg.architecture!r}); the stage schedule partitions "
+                "a transformer block stack")
+        m = self.module
+        if m.attn_impl != "dense" or m.mlp_impl != "dense":
+            raise ValueError(
+                "pipeline training runs dense transformer blocks; compose "
+                "long-context/MoE via attn_impl/mlp_impl WITHOUT "
+                "pipeline_stages, or keep the pipelined model dense "
+                f"(got attn_impl={m.attn_impl!r}, mlp_impl={m.mlp_impl!r})")
+        stages = self.mesh.shape.get(MODEL_AXIS, 1)
+        if stages != cfg.pipeline_stages:
+            raise ValueError(
+                f"pipeline_stages={cfg.pipeline_stages} must equal the "
+                f"mesh's '{MODEL_AXIS}' axis size ({stages}) — the stage "
+                "ring rides that axis")
+        if m.n_layers % cfg.pipeline_stages:
+            raise ValueError(
+                f"n_layers={m.n_layers} must divide evenly into "
+                f"pipeline_stages={cfg.pipeline_stages} stages")
+        if cfg.pipeline_microbatches < 1:
+            raise ValueError("pipeline_microbatches must be >= 1")
 
     # -- optimizer ------------------------------------------------------
     def _build_optimizer(self, total_steps: int) -> optax.GradientTransformation:
@@ -157,6 +214,8 @@ class Trainer:
                    input_dtype=np.float32) -> TrainState:
         """Initialize (or warm-start, for fine-tuning) the sharded TrainState."""
         self._tx = self._build_optimizer(total_steps)
+        if self._pp:
+            return self._init_state_pipelined(initial_bundle)
         if initial_bundle is not None:
             variables = _to_plain(initial_bundle.variables)
         else:
@@ -166,10 +225,12 @@ class Trainer:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
 
-        rule = _param_sharding_rule(self.mesh, self.config.tensor_parallel)
-        shardings = jax.tree_util.tree_map(
-            lambda leaf: rule(jax.ShapeDtypeStruct(np.shape(leaf),
-                                                   np.asarray(leaf).dtype)),
+        rule = _param_sharding_rule(self.mesh, self.config.tensor_parallel,
+                                    self.config.expert_parallel)
+        shardings = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: rule(
+                path, jax.ShapeDtypeStruct(np.shape(leaf),
+                                           np.asarray(leaf).dtype)),
             params)
         params = jax.tree_util.tree_map(jax.device_put, params, shardings)
         batch_stats = jax.tree_util.tree_map(
@@ -183,8 +244,61 @@ class Trainer:
         return TrainState(step=jnp.asarray(start, jnp.int32), params=params,
                           opt_state=opt_state, batch_stats=batch_stats)
 
+    # -- pipeline parallelism (pipeline_stages > 1) ----------------------
+    def _init_state_pipelined(self, initial_bundle) -> TrainState:
+        """TrainState whose params are the pipeline's stacked tree, block
+        layers sharded over the stage ('model') axis.  Warm starts convert
+        an ordinary TransformerLM bundle by stacking its blocks."""
+        from mmlspark_tpu.parallel.pipeline import (
+            init_pipelined_lm, pipeline_param_shardings,
+            pipeline_params_from_variables)
+        m = self.module
+        if initial_bundle is not None:
+            params = pipeline_params_from_variables(
+                _to_plain(initial_bundle.variables), m.n_layers)
+        else:
+            params = init_pipelined_lm(
+                jax.random.key(self.config.seed), vocab_size=m.vocab_size,
+                d_model=m.d_model, n_heads=m.n_heads, n_layers=m.n_layers,
+                max_len=m.max_len, mlp_ratio=m.mlp_ratio)
+        params = jax.device_put(
+            params, pipeline_param_shardings(self.mesh, params))
+        opt_state = jax.jit(self._tx.init)(params)
+        start = int((initial_bundle.metadata or {}).get("steps", 0)) \
+            if initial_bundle is not None else 0
+        return TrainState(step=jnp.asarray(start, jnp.int32), params=params,
+                          opt_state=opt_state, batch_stats={})
+
+    def _make_pipeline_train_step(self):
+        from mmlspark_tpu.parallel.pipeline import pipelined_lm_apply
+        mesh, m, cfg = self.mesh, self.module, self.config
+        loss_fn, tx = self._loss, self._tx
+        aux_w = float(cfg.aux_loss_weight)
+
+        def train_step(state: TrainState, x, y, mask):
+            def compute(params):
+                logits = pipelined_lm_apply(
+                    mesh, params, x, n_heads=m.n_heads,
+                    n_micro=cfg.pipeline_microbatches,
+                    stage_axis=MODEL_AXIS, mlp_ratio=m.mlp_ratio,
+                    dtype=m.dtype)
+                return loss_fn(logits, y, mask)
+
+            loss, grads = jax.value_and_grad(compute)(state.params)
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(step=state.step + 1, params=new_params,
+                                   opt_state=new_opt,
+                                   batch_stats=state.batch_stats)
+            return new_state, loss, {}
+
+        del aux_w  # dense pipeline blocks sow no losses (validated in init)
+        return jax.jit(train_step, donate_argnums=(0,))
+
     # -- the compiled step ----------------------------------------------
     def make_train_step(self):
+        if self._pp:
+            return self._make_pipeline_train_step()
         module, loss_fn = self.module, self._loss
         has_train = self._has_train_arg
         tx = self._tx
@@ -199,11 +313,11 @@ class Trainer:
                 if has_train:
                     out, mut = module.apply(
                         variables, x, train=True,
-                        mutable=["batch_stats", "losses"])
+                        mutable=["batch_stats", "losses", "metrics"])
                     new_stats = mut.get("batch_stats", state.batch_stats)
                 else:
                     out, mut = module.apply(variables, x,
-                                            mutable=["losses"])
+                                            mutable=["losses", "metrics"])
                     new_stats = state.batch_stats
                 loss = loss_fn(out, y, mask)
                 if aux_w:
@@ -212,15 +326,15 @@ class Trainer:
                     loss = loss + aux_w * sum(
                         jnp.asarray(v).sum() for v in
                         jax.tree_util.tree_leaves(mut.get("losses", {})))
-                return loss, new_stats
+                return loss, (new_stats, _fold_metrics(mut.get("metrics", {})))
 
-            (loss, new_stats), grads = jax.value_and_grad(
+            (loss, (new_stats, metrics)), grads = jax.value_and_grad(
                 compute, has_aux=True)(state.params)
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt, batch_stats=new_stats)
-            return new_state, loss
+            return new_state, loss, metrics
 
         return jax.jit(train_step, donate_argnums=(0,))
 
@@ -273,6 +387,11 @@ class Trainer:
                     f"collective); got {all_flags.tolist()}")
         bs = cfg.batch_size
         bs = max(bs - bs % data_size, data_size)
+        if self._pp:
+            # each data-shard's local batch must split into whole
+            # microbatches for the GPipe schedule
+            unit = data_size * cfg.pipeline_microbatches
+            bs = max(bs - bs % unit, unit)
         # rows this process feeds per global step; data_size % nproc == 0
         # and bs % data_size == 0 guarantee equal whole-row shares >= 1
         bs_local = bs // nproc
@@ -298,6 +417,7 @@ class Trainer:
                                  cfg.shuffle_each_epoch)
             self._rows_seen[order] = True
             losses: list = []
+            step_metrics: list = []
             for start in range(0, n, bs_local):
                 idx = order[start:start + bs_local]
                 valid = len(idx)
@@ -309,8 +429,10 @@ class Trainer:
                 xb = put_sharded(x[idx], x_sh)
                 yb = put_sharded(y[idx], x_sh)
                 mask_d = put_sharded(mask, x_sh)
-                state, loss = step_fn(state, xb, yb, mask_d)
+                state, loss, metrics = step_fn(state, xb, yb, mask_d)
                 losses.append(loss)  # device array; fetched at epoch end
+                if metrics:
+                    step_metrics.append(metrics)
                 step += 1
                 if cfg.checkpoint_dir and cfg.checkpoint_every_steps and \
                         step % cfg.checkpoint_every_steps == 0:
@@ -319,6 +441,12 @@ class Trainer:
             epoch_loss = float(np.sum(jax.device_get(losses)))
             rec = {"epoch": epoch, "loss": epoch_loss / max(n_batches, 1),
                    "wall_s": time.perf_counter() - t0}
+            if step_metrics:
+                # model-sown diagnostics (e.g. MoE overflow fraction)
+                # averaged over the epoch's steps, one history column each
+                fetched = jax.device_get(step_metrics)
+                for key in fetched[0]:
+                    rec[key] = float(np.mean([m[key] for m in fetched]))
             self.history.append(rec)
             emit = log_fn if log_fn is not None else get_logger("train").info
             if epoch % max(1, log_every) == 0 or epoch == cfg.epochs - 1:
@@ -328,20 +456,32 @@ class Trainer:
             self.save_checkpoint(state, cfg.checkpoint_dir)
         # the run's loss curve through the typed contract (Metrics.scala:37-47)
         self.training_metric_data().log("train", "debug")
+        self._last_state = state  # inspectable (sharding asserts, resume)
         return self.bundle_from_state(state)
 
     def training_metric_data(self) -> MetricData:
-        """This trainer's history as a typed metric table."""
+        """This trainer's history as a typed metric table (loss/wall plus
+        any model-sown diagnostic columns, e.g. moe_overflow_fraction)."""
+        extras = sorted({k for r in self.history for k in r}
+                        - {"epoch", "loss", "wall_s"})
+        cols = {key: [r.get(key, float("nan")) for r in self.history]
+                for key in ("epoch", "loss", "wall_s", *extras)}
         return MetricData.create_table(
-            {"epoch": [r["epoch"] for r in self.history],
-             "loss": [r["loss"] for r in self.history],
-             "wall_s": [r["wall_s"] for r in self.history]},
-            "training", self.config.architecture)
+            cols, "training", self.config.architecture)
 
     def bundle_from_state(self, state: TrainState) -> ModelBundle:
-        # collective under multi-host (gathers TP-sharded leaves); every
-        # process gets the full bundle
-        variables = {"params": gather_to_host(state.params, self.mesh)}
+        # collective under multi-host (gathers TP/EP/PP-sharded leaves);
+        # every process gets the full bundle
+        gathered = gather_to_host(state.params, self.mesh)
+        if self._pp:
+            # unstack the pipeline tree back into ordinary TransformerLM
+            # variables: the bundle scores through TPUModel like any other
+            from mmlspark_tpu.parallel.pipeline import (
+                variables_from_pipeline_params)
+            variables = variables_from_pipeline_params(
+                gathered, self.module.n_layers)
+        else:
+            variables = {"params": gathered}
         if state.batch_stats:
             variables["batch_stats"] = gather_to_host(state.batch_stats,
                                                       self.mesh)

@@ -8,8 +8,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
-from mmlspark_tpu.ops.moe import MoEMLP, expert_parallel_rules, top1_dispatch
+from mmlspark_tpu.ops.moe import (MoEMLP, expert_parallel_rules,
+                                  top1_dispatch, topk_dispatch)
 
 
 def test_top1_dispatch_properties():
@@ -59,6 +61,45 @@ def test_identical_experts_reduce_to_gated_mlp():
     ref = (jnp.maximum(xf @ w_in0, 0) @ w_out0) * gate[:, None]
     np.testing.assert_allclose(np.asarray(y).reshape(-1, 8),
                                np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_top2_dispatch_normalized_gates():
+    """GShard top-2: with ample capacity every token lands in exactly two
+    experts, the two normalized gates sum to 1, and nothing overflows."""
+    rng = np.random.default_rng(4)
+    logits = jnp.asarray(rng.standard_normal((24, 4)), jnp.float32)
+    dispatch, combine, aux, z, kept = topk_dispatch(logits, capacity=48, k=2)
+    d = np.asarray(dispatch)
+    per_token = d.reshape(24, -1).sum(1)
+    np.testing.assert_allclose(per_token, 2.0, atol=1e-6)   # two slots each
+    assert d.sum(0).max() <= 1.0 + 1e-6                     # no double-booked
+    gate_sums = np.asarray(combine).reshape(24, -1).sum(1)
+    np.testing.assert_allclose(gate_sums, 1.0, atol=1e-6)   # normalized
+    assert float(kept) == pytest.approx(1.0)
+    assert float(aux) > 0 and float(z) > 0
+
+
+def test_top2_overflow_counts_dropped_slots():
+    # every token's top-2 is experts {0, 1}; capacity 4 keeps 4 per expert
+    logits = jnp.broadcast_to(jnp.asarray([9.0, 8.0, -9.0, -9.0]), (12, 4))
+    dispatch, _, _, _, kept = topk_dispatch(logits, capacity=4, k=2)
+    assert np.asarray(dispatch).sum() == 8.0                # 4+4 of 24 slots
+    assert float(kept) == pytest.approx(8.0 / 24.0)
+
+
+def test_grouped_routing_bounds_dispatch_memory():
+    """MoEMLP routes per group: with group_size=8 the per-group capacity is
+    ceil(8/4 * 1.0) = 2, so at most G*E*C = 4*4*2 slots exist — the O(T^2)
+    ungrouped formulation would have allocated T*E*ceil(T/E) = 32*4*8."""
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((2, 16, 8)), jnp.float32)
+    moe = MoEMLP(d_model=8, n_experts=4, capacity_factor=1.0,
+                 dtype=jnp.float32, group_size=8)
+    vars_ = moe.init(jax.random.key(0), x)
+    y, state = moe.apply(vars_, x, mutable=["losses", "metrics"])
+    assert y.shape == x.shape and np.isfinite(np.asarray(y)).all()
+    overflow = jax.tree_util.tree_leaves(state["metrics"])[0]
+    assert 0.0 <= float(overflow) <= 1.0
 
 
 def test_aux_loss_prefers_balance():

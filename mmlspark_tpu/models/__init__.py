@@ -7,4 +7,6 @@ from mmlspark_tpu.models.definitions import (
     build_model,
 )
 from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
+from mmlspark_tpu.models.generate import (TextGenerator, generate,
+                                          make_generate_fn, naive_generate)
 from mmlspark_tpu.models.tpu_model import TPUModel

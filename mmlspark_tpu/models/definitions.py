@@ -239,6 +239,13 @@ class TransformerBlock(nn.Module):
                                   causal=True)
         else:
             raise ValueError(f"unknown attn_impl '{self.attn_impl}'")
+        # named for selective rematerialization: remat_policy
+        # 'save_attention' stores this tensor so the backward never re-runs
+        # the attention op (the flash backward already recomputes its own
+        # P = exp(S - LSE) internally — re-running the forward kernel on
+        # top of that is pure waste)
+        from jax.ad_checkpoint import checkpoint_name
+        o = checkpoint_name(o, "attn_out")
         x = x + nn.Dense(self.d_model, dtype=self.dtype,
                          name="proj")(o.reshape(b, s, self.d_model))
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -289,6 +296,11 @@ class TransformerLM(nn.Module, NodeMixin):
     remat: bool = False  # rematerialize each block's activations in the
     # backward (jax.checkpoint): trades ~1 extra forward of FLOPs for
     # O(n_layers) less activation HBM — the long-context training lever
+    remat_policy: str = "full"  # full | save_attention: 'save_attention'
+    # stores each block's attention output (+ the flash kernel's out/lse
+    # residuals) so the backward recomputes only the cheap dense ops, not
+    # the attention kernel itself — costs O(B*S*D) extra HBM per layer,
+    # nothing O(S^2)
 
     @nn.compact
     def __call__(self, tokens):
@@ -304,8 +316,19 @@ class TransformerLM(nn.Module, NodeMixin):
         pos_emb = nn.Embed(self.max_len, self.d_model,
                            dtype=self.dtype, name="pos_embed")(pos)
         x = self.node("embed", tok_emb + pos_emb[None])
-        block_cls = nn.remat(TransformerBlock) if self.remat \
-            else TransformerBlock
+        if not self.remat:
+            block_cls = TransformerBlock
+        elif self.remat_policy == "save_attention":
+            block_cls = nn.remat(
+                TransformerBlock,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "attn_out", "flash_out", "flash_lse"))
+        elif self.remat_policy == "full":
+            block_cls = nn.remat(TransformerBlock)
+        else:
+            raise ValueError(
+                f"unknown remat_policy '{self.remat_policy}' "
+                "(full | save_attention)")
         for i in range(self.n_layers):
             x = block_cls(
                 self.d_model, self.n_heads, self.mlp_ratio, self.dtype,

@@ -1,0 +1,288 @@
+"""Autoregressive generation with a KV cache: the product half of the
+long-context LM stack.
+
+The reference has no language model at all (SURVEY §2b headroom), but a
+framework that advertises flash/ring-attention training must also produce
+tokens.  Design is jit-once / static-shape throughout — the TPU decode
+recipe:
+
+  * **prefill**: one full forward over the (fixed-length) prompt writes
+    every layer's K/V into a max_len-sized cache and yields the first
+    sampled token.  Attention here is the ordinary causal batched matmul
+    (XLA fuses it; prompt lengths at scoring scale don't need the flash
+    kernel's memory discipline).
+  * **decode**: a `lax.scan` over step count; each step embeds ONE token,
+    updates the caches via `lax.dynamic_update_slice` at a traced
+    position, and attends the single query against the full cache under a
+    global position mask.  Shapes never change, so the whole generation
+    is one compiled program — no per-step dispatch, no retracing, no
+    Python in the loop.
+  * **sampling**: greedy (temperature 0) or temperature-scaled
+    categorical, decided at trace time.
+
+The decoder re-implements the TransformerLM block math as pure functions
+over the SAME flax param tree (models/definitions.py names: qkv / proj /
+mlp_up / mlp_down / LayerNorm_0/1), so any trained TransformerLM bundle —
+including one trained through pipeline parallelism and converted back —
+generates without re-exporting weights.  Parity with recompute-everything
+decoding is pinned by tests/test_generate.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
+
+NEG_INF = -1e30
+
+
+def _ln(p: dict, x: jax.Array, dtype) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + 1e-6)
+    return (y * p["scale"] + p["bias"]).astype(dtype)
+
+
+def _dense(p: dict, x: jax.Array, dtype) -> jax.Array:
+    return (x.astype(dtype) @ p["kernel"].astype(dtype)
+            + p["bias"].astype(dtype))
+
+
+def _block_with_cache(bp: dict, x: jax.Array, k_cache: jax.Array,
+                      v_cache: jax.Array, pos, n_heads: int, dtype):
+    """One TransformerBlock over a token segment starting at `pos`,
+    reading/writing the (B, max_len, H, Dh) caches.  Works for prefill
+    (S = prompt length, pos = 0) and decode (S = 1, traced pos) alike."""
+    b, s, d = x.shape
+    dh = d // n_heads
+    h = _ln(bp["LayerNorm_0"], x, dtype)
+    qkv = _dense(bp["qkv"], h, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, s, n_heads, dh)
+    q, k, v = (t.reshape(shape) for t in (q, k, v))
+    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                       (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                       (0, pos, 0, 0))
+    max_len = k_cache.shape[1]
+    scores = jnp.einsum("bqhd,blhd->bhql", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * dh ** -0.5
+    # global causal mask: query at pos+i sees cache slots 0..pos+i
+    q_pos = pos + jnp.arange(s)
+    visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]     # (S, L)
+    scores = jnp.where(visible[None, None], scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhql,blhd->bqhd", w, v_cache.astype(jnp.float32))
+    x = x + _dense(bp["proj"], o.reshape(b, s, d).astype(dtype), dtype)
+    h2 = _ln(bp["LayerNorm_1"], x, dtype)
+    mlp = _dense(bp["mlp_down"], jax.nn.gelu(
+        _dense(bp["mlp_up"], h2, dtype)), dtype)
+    return x + mlp, k_cache, v_cache
+
+
+def _forward_with_cache(params: dict, tokens: jax.Array, caches: list,
+                        pos, n_layers: int, n_heads: int, dtype):
+    """Logits (B, S, V) for a token segment at `pos`, updating the caches."""
+    s = tokens.shape[1]
+    positions = pos + jnp.arange(s)
+    emb = (params["tok_embed"]["embedding"][tokens]
+           + params["pos_embed"]["embedding"][positions][None])
+    x = emb.astype(dtype)
+    new_caches = []
+    for i in range(n_layers):
+        x, kc, vc = _block_with_cache(
+            params[f"block{i}_w"], x, caches[i][0], caches[i][1], pos,
+            n_heads, dtype)
+        new_caches.append((kc, vc))
+    # same dtype discipline as TransformerLM: final norm + head run in the
+    # model's compute dtype, logits emitted float32
+    x = _ln(params["final_norm_w"], x, dtype)
+    logits = _dense(params["lm_head"], x, dtype).astype(jnp.float32)
+    return logits, new_caches
+
+
+def _check_generatable(module) -> None:
+    if type(module).__name__ != "TransformerLM":
+        raise ValueError(
+            f"generate() decodes TransformerLM models, got "
+            f"{type(module).__name__}")
+    if module.mlp_impl != "dense":
+        raise ValueError(
+            "generate() supports dense MLP blocks; MoE decode (per-step "
+            "routing) is not implemented")
+    # any attention EXECUTION strategy trains the same weights; decode
+    # always attends q against the cache, so attn_impl needs no check
+
+
+def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
+                     temperature: float = 0.0):
+    """A jitted `(variables, prompts (B, P) int32, rng_key) -> (B, P+N)`
+    generation program for one (prompt_len, max_new_tokens) shape class.
+
+    Compiled once per shape class; TextGenerator caches these.  The prompt
+    must fit the model: prompt_len + max_new_tokens <= max_len (position
+    embeddings are the budget)."""
+    _check_generatable(module)
+    if prompt_len < 1:
+        raise ValueError("prompt_len must be >= 1")
+    if prompt_len + max_new_tokens > module.max_len:
+        raise ValueError(
+            f"prompt_len ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's max_len ({module.max_len})")
+    n_layers, n_heads = module.n_layers, module.n_heads
+    dh = module.d_model // n_heads
+    dtype = module.dtype
+    greedy = temperature <= 0.0
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits.astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    @jax.jit
+    def generate_fn(variables, prompts, key):
+        params = variables["params"]
+        b = prompts.shape[0]
+        caches = [(jnp.zeros((b, module.max_len, n_heads, dh), dtype),
+                   jnp.zeros((b, module.max_len, n_heads, dh), dtype))
+                  for _ in range(n_layers)]
+        logits, caches = _forward_with_cache(
+            params, prompts, caches, 0, n_layers, n_heads, dtype)
+        key, sub = jax.random.split(key)
+        tok = sample(logits[:, -1], sub)
+
+        def step(carry, step_key):
+            tok, pos, caches = carry
+            logits, caches = _forward_with_cache(
+                params, tok[:, None], caches, pos, n_layers, n_heads, dtype)
+            nxt = sample(logits[:, 0], step_key)
+            return (nxt, pos + 1, caches), tok
+
+        if max_new_tokens > 1:
+            (tok, _, _), toks = lax.scan(
+                step, (tok, jnp.asarray(prompt_len, jnp.int32), caches),
+                jax.random.split(key, max_new_tokens - 1))
+            generated = jnp.concatenate(
+                [toks.transpose(1, 0), tok[:, None]], axis=1)
+        else:
+            generated = tok[:, None]
+        return jnp.concatenate([prompts, generated], axis=1)
+
+    return generate_fn
+
+
+def generate(module, variables, prompts, max_new_tokens: int,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> np.ndarray:
+    """One-shot convenience wrapper around `make_generate_fn` (which is
+    the jit-once API for repeated calls)."""
+    prompts = jnp.asarray(prompts, jnp.int32)
+    fn = make_generate_fn(module, prompts.shape[1], max_new_tokens,
+                          temperature)
+    key = rng if rng is not None else jax.random.key(0)
+    return np.asarray(fn(variables, prompts, key))
+
+
+class TextGenerator(Transformer):
+    """Pipeline Transformer: a token-prompt column in, a generated-token
+    column out — the LM counterpart of TPUModel's scoring loop.
+
+    Rows are grouped by prompt length (each length is its own compiled
+    shape class — the same static-shape discipline as
+    vision/transformer.py's ragged grouping) and decoded through the
+    jit-once KV-cache program; output rows align with input rows.
+    """
+
+    inputCol = Param(None, "column of int token-id prompt arrays",
+                     ptype=str)
+    outputCol = Param("generated", "output column (prompt + new tokens)",
+                      ptype=str)
+    maxNewTokens = Param(32, "tokens to generate per row", ptype=int,
+                         validator=lambda v: v > 0)
+    temperature = Param(0.0, "0 = greedy; > 0 samples with this "
+                        "temperature", ptype=float,
+                        validator=lambda v: v >= 0)
+    seed = Param(0, "sampling seed (ignored when greedy)", ptype=int)
+
+    def __init__(self, bundle: Optional["ModelBundle"] = None, **kwargs):
+        super().__init__(**kwargs)
+        self._bundle = bundle
+        self._compiled: dict = {}
+
+    def set_bundle(self, bundle: "ModelBundle") -> "TextGenerator":
+        self._bundle = bundle
+        self._compiled.clear()
+        return self
+
+    @property
+    def bundle(self) -> Optional["ModelBundle"]:
+        return self._bundle
+
+    def _fn_for(self, prompt_len: int):
+        key = (prompt_len, self.maxNewTokens, self.temperature)
+        if key not in self._compiled:
+            self._compiled[key] = make_generate_fn(
+                self._bundle.module(), prompt_len, self.maxNewTokens,
+                self.temperature)
+        return self._compiled[key]
+
+    def transform(self, table: "DataTable") -> "DataTable":
+        self._check_required()
+        if self._bundle is None:
+            raise ValueError(
+                "TextGenerator has no model bundle; call set_bundle()")
+        col = table[self.inputCol]
+        rows = [np.asarray(r, np.int32) for r in col]
+        n = len(rows)
+        out: list = [None] * n
+        by_len: dict[int, list[int]] = {}
+        for i, r in enumerate(rows):
+            by_len.setdefault(len(r), []).append(i)
+        for plen, idxs in sorted(by_len.items()):
+            fn = self._fn_for(plen)
+            prompts = jnp.asarray(np.stack([rows[i] for i in idxs]))
+            key = jax.random.key(self.seed)
+            got = np.asarray(fn(self._bundle.variables, prompts, key))
+            for j, i in enumerate(idxs):
+                out[i] = got[j]
+        if n and len(by_len) == 1:
+            return table.with_column(self.outputCol, np.stack(out))
+        result = np.empty(n, object)
+        for i, r in enumerate(out):
+            result[i] = r
+        return table.with_column(self.outputCol, result)
+
+    def _save_extra(self, path: str) -> None:
+        if self._bundle is not None:
+            save_bundle(self._bundle, f"{path}/bundle")
+
+    def _load_extra(self, path: str) -> None:
+        import os
+        self._bundle = (load_bundle(f"{path}/bundle")
+                        if os.path.exists(f"{path}/bundle") else None)
+        self._compiled = {}
+
+
+def naive_generate(module, variables, prompts, max_new_tokens: int) -> np.ndarray:
+    """Recompute-everything greedy decoding through the ordinary module
+    forward — O(N * S^2) work, no cache.  The parity oracle for
+    `generate`; never the product path."""
+    _check_generatable(module)
+    toks = jnp.asarray(prompts, jnp.int32)
+    for _ in range(max_new_tokens):
+        logits = module.apply(variables, toks)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    return np.asarray(toks)

@@ -364,6 +364,12 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     if interpret or (block_q % 8 == 0 and block_k % 8 == 0):
         out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
                                   interpret, with_lse=True)
+        # named residuals: under jax.checkpoint with the 'save_attention'
+        # policy (models/definitions.py) these are STORED, so the remat
+        # backward reuses them instead of re-running the forward kernel
+        from jax.ad_checkpoint import checkpoint_name
+        out = checkpoint_name(out, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
         return out, (q, k, v, out, lse)
     out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
     return out, (q, k, v, None, None)

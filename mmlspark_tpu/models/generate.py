@@ -25,7 +25,12 @@ over the SAME flax param tree (models/definitions.py names: qkv / proj /
 mlp_up / mlp_down / LayerNorm_0/1), so any trained TransformerLM bundle —
 including one trained through pipeline parallelism and converted back —
 generates without re-exporting weights.  Parity with recompute-everything
-decoding is pinned by tests/test_generate.py.
+decoding is pinned exactly at float32 by tests/test_generate.py.  One
+deliberate dtype difference: decode attention accumulates QK^T / PV in
+float32 (the single-query step is bandwidth-bound, so the extra precision
+is free), while the training forward's einsums run in the model dtype —
+for bfloat16 bundles the logits agree to bf16 rounding (test-pinned), and
+near-tie greedy choices may legitimately resolve differently.
 """
 
 from __future__ import annotations
@@ -135,6 +140,8 @@ def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
     _check_generatable(module)
     if prompt_len < 1:
         raise ValueError("prompt_len must be >= 1")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
     if prompt_len + max_new_tokens > module.max_len:
         raise ValueError(
             f"prompt_len ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
@@ -153,6 +160,12 @@ def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
 
     @jax.jit
     def generate_fn(variables, prompts, key):
+        if prompts.shape[1] != prompt_len:
+            # static at trace time; a mismatched reuse of a compiled fn
+            # would otherwise decode against never-written cache slots
+            raise ValueError(
+                f"prompts have length {prompts.shape[1]} but this "
+                f"generate_fn was built for prompt_len={prompt_len}")
         params = variables["params"]
         b = prompts.shape[0]
         caches = [(jnp.zeros((b, module.max_len, n_heads, dh), dtype),

@@ -61,9 +61,35 @@ def test_budget_validation(lm_bundle):
     module = lm_bundle.module()
     with pytest.raises(ValueError, match="max_len"):
         make_generate_fn(module, prompt_len=20, max_new_tokens=8)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        make_generate_fn(module, prompt_len=4, max_new_tokens=0)
     moe = build_model("TransformerLM", dict(CFG, mlp_impl="moe"))
     with pytest.raises(ValueError, match="MoE"):
         make_generate_fn(moe, prompt_len=4, max_new_tokens=2)
+    fn = make_generate_fn(module, prompt_len=6, max_new_tokens=2)
+    with pytest.raises(ValueError, match="prompt_len=6"):
+        fn(lm_bundle.variables, jnp.zeros((1, 4), jnp.int32),
+           jax.random.key(0))
+
+
+def test_bf16_decode_logits_match_module_forward():
+    """The shipped default dtype: the decode path's prefill logits must
+    agree with module.apply to bfloat16 rounding (decode accumulates
+    attention in f32 — see module docstring — so exact bit parity is not
+    the contract; closeness at bf16 resolution is)."""
+    from mmlspark_tpu.models.generate import _forward_with_cache
+
+    lm = build_model("TransformerLM", dict(CFG, dtype="bfloat16"))
+    toks = jnp.asarray(np.random.default_rng(1).integers(0, 32, (2, 8)),
+                       jnp.int32)
+    variables = lm.init(jax.random.key(0), toks)
+    ref = np.asarray(lm.apply(variables, toks), np.float32)
+    caches = [(jnp.zeros((2, CFG["max_len"], 4, 8), jnp.bfloat16),
+               jnp.zeros((2, CFG["max_len"], 4, 8), jnp.bfloat16))
+              for _ in range(CFG["n_layers"])]
+    got, _ = _forward_with_cache(variables["params"], toks, caches, 0,
+                                 CFG["n_layers"], 4, jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=0.05, atol=0.05)
 
 
 def test_text_generator_stage(lm_bundle, tmp_path):

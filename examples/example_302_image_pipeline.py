@@ -6,8 +6,11 @@ Image Transformations.ipynb`): read images from REMOTE storage over HTTP
 notebook's wasb:// reads, BinaryFileReader.scala:28-69 /
 AzureBlobReader.scala:12-47; a local HTTP server stands in for the blob
 store), run batched ImageTransformer ops (resize, crop, flip — the OpenCV
-stage pipeline), featurize with the TRAINED zoo model's dense1 layer
-(ImageFeaturizer), and train a classifier on the features.
+stage pipeline), featurize with the TRAINED zoo
+ResNet's bottleneck pool layer (ImageFeaturizer over ResNetDigits — the
+reference's transfer suite ran a real ResNet50 the same way,
+ImageFeaturizerSuite.scala:45-53), and train a classifier on the
+features.
 """
 
 import http.server
@@ -76,10 +79,11 @@ def main(verbose: bool = True) -> dict:
                        .transform(table))
         assert transformed["image"].shape[1:] == (32, 32, 3)
 
-        # transfer learning via the TRAINED zoo ConvNet's dense1 features
+        # transfer learning via the TRAINED zoo ResNet's bottleneck pool
+        # features (cutOutputLayers=1 -> the 128-dim global-average node)
         dl = ModelDownloader(os.path.join(root, "cache"))
         bundle = dl.load_bundle(
-            dl.download_by_name(pretrained_repo(), "ConvNet"))
+            dl.download_by_name(pretrained_repo(), "ResNetDigits"))
         feats = ImageFeaturizer(bundle, inputCol="image",
                                 outputCol="features",
                                 cutOutputLayers=1).transform(transformed)

@@ -227,10 +227,13 @@ def pretrained_repo() -> LocalRepo:
 
     The reference serves trained models from a CDN
     (ModelDownloader.scala:109-157); an air-gapped TPU build ships them as
-    package data instead.  Currently holds ConvNet/UCIDigits — the flagship
-    ConvNetCIFAR10 architecture trained by scripts/train_zoo_model.py on
-    the real UCI handwritten-digits images (98.9% held-out accuracy; see
-    the .meta and bundle metadata for the exact figures).
+    package data instead.  Holds four trained bundles published by
+    scripts/train_zoo_model.py: ConvNet/UCIDigits and
+    ResNetDigits/UCIDigits (real UCI handwritten-digits images, ~99%
+    held-out accuracy each), TabularWDBC/WDBC (real UCI breast-cancer
+    table), and TextSentiment/Reviews (TextFeaturizer chain + MLP head);
+    each bundle's metadata records its dataset, accuracy, and — where
+    scoring needs it — the featurization/standardization recipe.
     """
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "pretrained")

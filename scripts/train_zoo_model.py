@@ -1,20 +1,34 @@
-"""Train the flagship ConvNet on real data and publish it to the package zoo.
+"""Train REAL models and publish them to the package zoo.
 
-Produces the repo's pretrained model artifact — the counterpart of the
-reference's CDN-hosted trained models (ModelDownloader.scala:109-157,
-ConvNet_CIFAR10.model in CNTKTestUtils.scala:12-36).  CIFAR-10's raw data
-needs network egress this build does not have, so the model trains on the
-REAL UCI handwritten-digits images shipped inside scikit-learn
-(utils/demo_data.py::digits_images) — trained weights, genuine held-out
-accuracy, semantically meaningful features (docs/design_cuts.md records the
-substitution).
+Produces the repo's pretrained model artifacts — the counterpart of the
+reference's CDN-hosted trained-model catalog (ModelDownloader.scala:109-157,
+its transfer-learning suite runs a real ResNet50,
+ImageFeaturizerSuite.scala:45-53).  One bundle is not a zoo (round-4
+missing #1), so this publishes FOUR:
+
+  * ConvNet / UCIDigits      — the flagship scorer (notebook-301 class)
+  * ResNetDigits / UCIDigits — a bottleneck-block ResNet, so
+                               ImageFeaturizer's ResNet-class transfer
+                               path runs on trained weights
+  * TextSentiment / Reviews  — TextFeaturizer chain + trained MLP head
+                               (notebook-201 class); featurization config
+                               rides the metadata so scoring reproduces it
+  * TabularWDBC / WDBC       — MLP on the real UCI breast-cancer table
+                               (the benchmark grid's anchor dataset)
+
+CIFAR-10's raw data needs network egress this build does not have, so the
+image models train on the REAL UCI handwritten-digits images shipped
+inside scikit-learn (utils/demo_data.py::digits_images); WDBC is likewise
+real sklearn-shipped data.  The reviews corpus is the synthetic
+notebook-201 one (docs/design_cuts.md §4 records both substitutions).
 
 The entire flow is the framework's own: Trainer fits, TPUModel scores the
-held-out split, LocalRepo.add_model packs + hashes + writes the .meta, and
-the result is committed as package data under mmlspark_tpu/zoo/pretrained/
-so `pretrained_repo()` works from any install.
+held-out split, LocalRepo.add_model packs + hashes + writes the .meta,
+and the results are committed as package data under
+mmlspark_tpu/zoo/pretrained/ so `pretrained_repo()` works from any
+install.
 
-Run (any backend; deterministic per backend, ~1 min on CPU):
+Run (any backend; deterministic per backend, a few minutes on CPU):
     python scripts/train_zoo_model.py
 """
 
@@ -30,38 +44,42 @@ PRETRAINED_DIR = os.path.join(
     "mmlspark_tpu", "zoo", "pretrained")
 
 LAYER_NAMES = ["z", "dense1", "pool3", "pool2", "pool1"]
+RESNET_LAYER_NAMES = ["z", "pool", "stage3", "stage2", "stage1", "stem"]
+# small bottleneck-block ResNet (ResNet-50's block type at digit scale):
+# pool node is 4*32 = 128-wide — the transfer-learning feature layer
+RESNET_CONFIG = {"stage_sizes": [1, 1, 1], "widths": [8, 16, 32],
+                 "num_classes": 10, "block_kind": "bottleneck"}
+# hashing-only featurization (no IDF): features are a pure function of
+# the config, so a downloaded head reproduces them from metadata alone
+TEXT_FEATURIZER_CONFIG = {"inputCol": "text", "outputCol": "features",
+                          "numFeatures": 1 << 12, "useIDF": False,
+                          "useStopWordsRemover": True}
 
 
-def main():
+def _accuracy(bundle, col, x, y):
     from mmlspark_tpu import DataTable
     from mmlspark_tpu.models import TPUModel
+    scored = TPUModel(bundle, inputCol=col, outputCol="scores",
+                      miniBatchSize=256).transform(DataTable({col: x}))
+    return float((np.argmax(scored["scores"], axis=1) == y).mean())
+
+
+def train_convnet(repo):
     from mmlspark_tpu.train import Trainer, TrainerConfig
     from mmlspark_tpu.utils.demo_data import digits_images
-    from mmlspark_tpu.zoo import LocalRepo
 
     x_train, y_train, x_test, y_test = digits_images()
-    print(f"train {x_train.shape} test {x_test.shape}")
-
     trainer = Trainer(TrainerConfig(
-        architecture="ConvNetCIFAR10",
-        model_config={},
+        architecture="ConvNetCIFAR10", model_config={},
         optimizer="adam", learning_rate=1e-3, lr_schedule="cosine",
         epochs=30, batch_size=128, loss="softmax_xent", seed=0))
     # uint8 -> float32 [0, 255]: the same contract TPUModel applies at
     # scoring time (cast on device, no normalization)
     bundle = trainer.fit_arrays(x_train.astype(np.float32), y_train)
-
-    def accuracy(x, y):
-        scored = TPUModel(bundle, inputCol="image", outputCol="scores",
-                          miniBatchSize=256).transform(
-            DataTable({"image": x}))
-        return float((np.argmax(scored["scores"], axis=1) == y).mean())
-
-    train_acc = accuracy(x_train, y_train)
-    test_acc = accuracy(x_test, y_test)
-    print(f"train accuracy {train_acc:.4f}  test accuracy {test_acc:.4f}")
+    train_acc = _accuracy(bundle, "image", x_train, y_train)
+    test_acc = _accuracy(bundle, "image", x_test, y_test)
+    print(f"ConvNet: train {train_acc:.4f}  test {test_acc:.4f}")
     assert test_acc >= 0.90, f"refusing to publish a weak model: {test_acc}"
-
     bundle.metadata.update({
         "input_shape": [1, 32, 32, 3],
         "layer_names": LAYER_NAMES,
@@ -71,11 +89,137 @@ def main():
         "train_accuracy": round(train_acc, 4),
         "test_accuracy": round(test_acc, 4),
     })
+    return repo.add_model(bundle, "ConvNet", "UCIDigits")
+
+
+def train_resnet(repo):
+    from mmlspark_tpu.train import Trainer, TrainerConfig
+    from mmlspark_tpu.utils.demo_data import digits_images
+
+    x_train, y_train, x_test, y_test = digits_images()
+    trainer = Trainer(TrainerConfig(
+        architecture="ResNet", model_config=dict(RESNET_CONFIG),
+        optimizer="adam", learning_rate=2e-3, lr_schedule="cosine",
+        epochs=40, batch_size=128, loss="softmax_xent", seed=1))
+    bundle = trainer.fit_arrays(x_train.astype(np.float32), y_train)
+    train_acc = _accuracy(bundle, "image", x_train, y_train)
+    test_acc = _accuracy(bundle, "image", x_test, y_test)
+    print(f"ResNetDigits: train {train_acc:.4f}  test {test_acc:.4f}")
+    assert test_acc >= 0.90, f"refusing to publish a weak model: {test_acc}"
+    bundle.metadata.update({
+        "input_shape": [1, 32, 32, 3],
+        "layer_names": RESNET_LAYER_NAMES,
+        "pretrained": True,
+        "train_dataset": "UCI handwritten digits (sklearn load_digits), "
+                         "upscaled 8x8 -> 32x32x3",
+        "train_accuracy": round(train_acc, 4),
+        "test_accuracy": round(test_acc, 4),
+    })
+    return repo.add_model(bundle, "ResNetDigits", "UCIDigits")
+
+
+def train_text(repo):
+    from mmlspark_tpu.feature.text import TextFeaturizer
+    from mmlspark_tpu.train import Trainer, TrainerConfig
+    from mmlspark_tpu.utils.demo_data import book_reviews_like
+
+    from mmlspark_tpu.feature.hashing import densify_sparse_column
+
+    table = book_reviews_like(n=2000, seed=2)
+    labels = (np.asarray(table["rating"]) >= 3).astype(np.int32)
+    feats_model = TextFeaturizer(**TEXT_FEATURIZER_CONFIG).fit(table)
+    feats = densify_sparse_column(
+        feats_model.transform(table)["features"],
+        num_features=TEXT_FEATURIZER_CONFIG["numFeatures"])
+    n_test = len(feats) // 5
+    x_train, y_train = feats[n_test:], labels[n_test:]
+    x_test, y_test = feats[:n_test], labels[:n_test]
+    trainer = Trainer(TrainerConfig(
+        architecture="MLPClassifier",
+        model_config={"hidden_sizes": [64], "num_classes": 2},
+        optimizer="adam", learning_rate=1e-3, lr_schedule="cosine",
+        epochs=12, batch_size=128, loss="softmax_xent", seed=2))
+    bundle = trainer.fit_arrays(x_train, y_train)
+    train_acc = _accuracy(bundle, "features", x_train, y_train)
+    test_acc = _accuracy(bundle, "features", x_test, y_test)
+    print(f"TextSentiment: train {train_acc:.4f}  test {test_acc:.4f}")
+    assert test_acc >= 0.90, f"refusing to publish a weak model: {test_acc}"
+    bundle.metadata.update({
+        "input_shape": [1, TEXT_FEATURIZER_CONFIG["numFeatures"]],
+        "pretrained": True,
+        # scoring recipe: features are a pure function of this config
+        # (hashing only, no fitted IDF state)
+        "featurizer": dict(TEXT_FEATURIZER_CONFIG),
+        "train_dataset": "synthetic book-review sentiment corpus "
+                         "(utils/demo_data.py::book_reviews_like; no real "
+                         "text corpus ships in an air-gapped build — "
+                         "docs/design_cuts.md §4)",
+        "train_accuracy": round(train_acc, 4),
+        "test_accuracy": round(test_acc, 4),
+    })
+    return repo.add_model(bundle, "TextSentiment", "Reviews",
+                          model_type="text")
+
+
+def train_tabular(repo):
+    from sklearn.datasets import load_breast_cancer
+
+    from mmlspark_tpu.train import Trainer, TrainerConfig
+
+    d = load_breast_cancer()
+    x = d.data.astype(np.float32)
+    y = d.target.astype(np.int32)
+    order = np.random.default_rng(3).permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = len(x) // 5
+    mean = x[n_test:].mean(axis=0)
+    std = x[n_test:].std(axis=0) + 1e-6
+    xs = (x - mean) / std
+    x_train, y_train = xs[n_test:], y[n_test:]
+    x_test, y_test = xs[:n_test], y[:n_test]
+    trainer = Trainer(TrainerConfig(
+        architecture="MLPClassifier",
+        model_config={"hidden_sizes": [32], "num_classes": 2},
+        optimizer="adam", learning_rate=1e-3, lr_schedule="cosine",
+        epochs=40, batch_size=64, loss="softmax_xent", seed=3))
+    bundle = trainer.fit_arrays(x_train, y_train)
+    train_acc = _accuracy(bundle, "features", x_train, y_train)
+    test_acc = _accuracy(bundle, "features", x_test, y_test)
+    print(f"TabularWDBC: train {train_acc:.4f}  test {test_acc:.4f}")
+    assert test_acc >= 0.93, f"refusing to publish a weak model: {test_acc}"
+    bundle.metadata.update({
+        "input_shape": [1, x.shape[1]],
+        "pretrained": True,
+        # standardization is part of the model contract: score with
+        # (x - feature_means) / feature_stds
+        "feature_means": [round(float(v), 6) for v in mean],
+        "feature_stds": [round(float(v), 6) for v in std],
+        "train_dataset": "REAL UCI breast-cancer (WDBC, sklearn "
+                         "load_breast_cancer), standardized",
+        "train_accuracy": round(train_acc, 4),
+        "test_accuracy": round(test_acc, 4),
+    })
+    return repo.add_model(bundle, "TabularWDBC", "WDBC",
+                          model_type="generic")
+
+
+def main():
+    from mmlspark_tpu.zoo import LocalRepo
+
     repo = LocalRepo(PRETRAINED_DIR)
-    schema = repo.add_model(bundle, "ConvNet", "UCIDigits")
+    only = sys.argv[1:] or ["convnet", "resnet", "text", "tabular"]
+    trainers = {"convnet": train_convnet, "resnet": train_resnet,
+                "text": train_text, "tabular": train_tabular}
+    unknown = set(only) - set(trainers)
+    if unknown:
+        sys.exit(f"unknown model(s) {sorted(unknown)}; "
+                 f"choose from {sorted(trainers)}")
+    for name in only:
+        schema = trainers[name](repo)
+        print(f"published {schema.filename} ({schema.size} bytes, "
+              f"sha256 {schema.hash[:12]}...)")
     repo.export_manifest()
-    print(f"published {schema.filename} ({schema.size} bytes, "
-          f"sha256 {schema.hash[:12]}...) -> {PRETRAINED_DIR}")
+    print(f"manifest exported -> {PRETRAINED_DIR}")
 
 
 if __name__ == "__main__":

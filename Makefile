@@ -1,12 +1,18 @@
 # Developer entry points (the reference's `runme` + sbt targets,
 # tools/runme/runme.sh:30-52 + src/project/build.scala).
-.PHONY: check test lint bench bench-smoke tpu-floors install docs notebooks clean
+.PHONY: check check-full test test-full lint bench bench-smoke tpu-floors install docs notebooks clean
 
 check:            ## full gate: syntax + lint + suite + dryrun + bench smoke
 	bash scripts/check.sh
 
-test:             ## CPU-mesh test suite
+test:             ## CPU-mesh test suite, fast tier (deselects `slow`)
 	python -m pytest tests/ -q
+
+test-full:        ## the WHOLE suite incl. slow compile-bound parity tests
+	python -m pytest tests/ -q -m ""
+
+check-full:       ## full gate with the whole suite
+	bash scripts/check.sh --full
 
 lint:             ## AST lint (unused imports, bare except, tabs)
 	python scripts/lint.py

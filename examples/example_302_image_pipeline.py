@@ -15,6 +15,7 @@ features.
 
 import http.server
 import os
+import shutil
 import tempfile
 import threading
 
@@ -45,60 +46,69 @@ def _write_image_dir(root: str, n: int = 96) -> int:
     return n
 
 
+def _read_over_http(root: str):
+    """Serve `root` on a loopback HTTP port and ingest it REMOTELY: the
+    same read_images call a gs://-bucket deployment uses (io/remote.py)."""
+    class _Quiet(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=root, **kw)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Quiet)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_port}/"
+        return read_images(url, pattern="*.png")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
 def main(verbose: bool = True) -> dict:
     log = print if verbose else (lambda *a, **k: None)
-    with tempfile.TemporaryDirectory() as root:
-        n = _write_image_dir(root, n=96)
 
-        # serve the directory over HTTP and ingest it REMOTELY: the same
-        # read_images call a gs://-bucket deployment uses (io/remote.py)
-        class _Quiet(http.server.SimpleHTTPRequestHandler):
-            def __init__(self, *a, **kw):
-                super().__init__(*a, directory=root, **kw)
+    # stage a 2-class image corpus and ingest it over HTTP: remote-storage
+    # reads are the reference notebook's wasb:// path (a loopback server
+    # stands in for the blob store)
+    root = tempfile.mkdtemp()
+    n = _write_image_dir(root, n=96)
+    table = _read_over_http(root)
+    log(f"read {table.num_rows}/{n} images over HTTP "
+        f"-> dense tensor {table['image'].shape}")
+    labels = np.asarray(
+        [0.0 if "class0" in p else 1.0 for p in table["path"]])
+    table = table.with_column("label", labels)
 
-            def log_message(self, *a):
-                pass
+    # batched transformer ops (the OpenCV stage pipeline)
+    transformed = (ImageTransformer(inputCol="image", outputCol="image")
+                   .resize(40, 40).center_crop(32, 32).flip()
+                   .transform(table))
+    assert transformed["image"].shape[1:] == (32, 32, 3)
 
-        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Quiet)
-        threading.Thread(target=httpd.serve_forever, daemon=True).start()
-        try:
-            url = f"http://127.0.0.1:{httpd.server_port}/"
-            table = read_images(url, pattern="*.png")
-        finally:
-            httpd.shutdown()
-            httpd.server_close()
-        log(f"read {table.num_rows}/{n} images over HTTP "
-            f"-> dense tensor {table['image'].shape}")
-        labels = np.asarray(
-            [0.0 if "class0" in p else 1.0 for p in table["path"]])
-        table = table.with_column("label", labels)
+    # transfer learning via the TRAINED zoo ResNet's bottleneck pool
+    # features (cutOutputLayers=1 -> the 128-dim global-average node)
+    dl = ModelDownloader(os.path.join(root, "cache"))
+    bundle = dl.load_bundle(
+        dl.download_by_name(pretrained_repo(), "ResNetDigits"))
+    feats = ImageFeaturizer(bundle, inputCol="image",
+                            outputCol="features",
+                            cutOutputLayers=1).transform(transformed)
+    log(f"featurized: {feats['features'].shape}")
 
-        # batched transformer ops (the OpenCV stage pipeline)
-        transformed = (ImageTransformer(inputCol="image", outputCol="image")
-                       .resize(40, 40).center_crop(32, 32).flip()
-                       .transform(table))
-        assert transformed["image"].shape[1:] == (32, 32, 3)
-
-        # transfer learning via the TRAINED zoo ResNet's bottleneck pool
-        # features (cutOutputLayers=1 -> the 128-dim global-average node)
-        dl = ModelDownloader(os.path.join(root, "cache"))
-        bundle = dl.load_bundle(
-            dl.download_by_name(pretrained_repo(), "ResNetDigits"))
-        feats = ImageFeaturizer(bundle, inputCol="image",
-                                outputCol="features",
-                                cutOutputLayers=1).transform(transformed)
-        log(f"featurized: {feats['features'].shape}")
-
-        train = feats.slice(0, 72)
-        test = feats.slice(72, feats.num_rows)
-        model = TrainClassifier(LogisticRegression(), labelCol="label").fit(
-            train.drop("image", "path"))
-        metrics = ComputeModelStatistics().transform(
-            model.transform(test.drop("image", "path")))
-        acc = float(metrics["accuracy"][0])
-        log(f"transfer-learning accuracy: {acc:.3f}")
-        return {"n_images": table.num_rows, "accuracy": acc,
-                "feature_dim": feats["features"].shape[1]}
+    # train a classifier on the transferred features, evaluate held-out
+    train = feats.slice(0, 72)
+    test = feats.slice(72, feats.num_rows)
+    model = TrainClassifier(LogisticRegression(), labelCol="label").fit(
+        train.drop("image", "path"))
+    metrics = ComputeModelStatistics().transform(
+        model.transform(test.drop("image", "path")))
+    acc = float(metrics["accuracy"][0])
+    log(f"transfer-learning accuracy: {acc:.3f}")
+    shutil.rmtree(root, ignore_errors=True)  # staged corpus + model cache
+    return {"n_images": table.num_rows, "accuracy": acc,
+            "feature_dim": feats["features"].shape[1]}
 
 
 if __name__ == "__main__":

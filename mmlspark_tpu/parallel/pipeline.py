@@ -79,15 +79,25 @@ def _head(params, x):
     return z
 
 
-def _apply_stage(block: TransformerBlock, local_blocks, x):
-    """Apply this stage's stacked layers (L_local, ...) sequentially."""
+def _apply_stage(block: TransformerBlock, local_blocks, x,
+                 remat: bool = False):
+    """Apply this stage's stacked layers (L_local, ...) sequentially.
+
+    With `remat`, each layer's activations are rematerialized in the
+    backward (jax.checkpoint per scan step) — the standard memory lever
+    when a stage holds many layers."""
     def body(h, layer_params):
         return block.apply({"params": layer_params}, h), None
+    if remat:
+        # scan already prevents the unsound CSE; the default True would
+        # insert needless optimization barriers on TPU
+        body = jax.checkpoint(body, prevent_cse=False)
     out, _ = lax.scan(body, x, local_blocks)
     return out
 
 
-def _pipeline_blocks(block, local_blocks, x, stage_axis: str, n_micro: int):
+def _pipeline_blocks(block, local_blocks, x, stage_axis: str, n_micro: int,
+                     remat: bool = False):
     """The GPipe schedule proper (runs inside shard_map)."""
     n_stages = lax.psum(1, stage_axis)
     idx = lax.axis_index(stage_axis)
@@ -106,7 +116,7 @@ def _pipeline_blocks(block, local_blocks, x, stage_axis: str, n_micro: int):
         # results never reach a valid output slot (they would arrive after
         # the final tick), so no masking of the compute itself is needed.
         cur = jnp.where(idx == 0, xs[jnp.clip(t, 0, n_micro - 1)], buf)
-        y = _apply_stage(block, local_blocks, cur)
+        y = _apply_stage(block, local_blocks, cur, remat)
         m = t - (n_stages - 1)
         valid = (m >= 0) & (idx == n_stages - 1)
         mclip = jnp.clip(m, 0, n_micro - 1)
@@ -128,14 +138,16 @@ def _pipeline_blocks(block, local_blocks, x, stage_axis: str, n_micro: int):
 
 def pipelined_lm_apply(mesh, params, tokens, *, n_heads: int,
                        n_micro: int = 4, stage_axis: str = MODEL_AXIS,
-                       mlp_ratio: int = 4, dtype=jnp.float32):
+                       mlp_ratio: int = 4, dtype=jnp.float32,
+                       remat: bool = False):
     """Forward logits through the dp x pp mesh (jit-compatible)."""
     d_model = params["norm_scale"].shape[0]
     block = TransformerBlock(d_model, n_heads, mlp_ratio, dtype)
 
     def fn(p, t):
         x = _embed(p, t).astype(dtype)
-        x = _pipeline_blocks(block, p["blocks"], x, stage_axis, n_micro)
+        x = _pipeline_blocks(block, p["blocks"], x, stage_axis, n_micro,
+                             remat)
         return _head(p, x.astype(jnp.float32))
 
     blocks_spec = jax.tree_util.tree_map(

@@ -167,6 +167,11 @@ class Trainer:
                 "long-context/MoE via attn_impl/mlp_impl WITHOUT "
                 "pipeline_stages, or keep the pipelined model dense "
                 f"(got attn_impl={m.attn_impl!r}, mlp_impl={m.mlp_impl!r})")
+        if m.remat and m.remat_policy != "full":
+            raise ValueError(
+                "pipeline training supports remat_policy='full' only (the "
+                "stage scan checkpoints whole layers); got "
+                f"remat_policy={m.remat_policy!r}")
         stages = self.mesh.shape.get(MODEL_AXIS, 1)
         if stages != cfg.pipeline_stages:
             raise ValueError(
@@ -281,7 +286,7 @@ class Trainer:
                     mesh, params, x, n_heads=m.n_heads,
                     n_micro=cfg.pipeline_microbatches,
                     stage_axis=MODEL_AXIS, mlp_ratio=m.mlp_ratio,
-                    dtype=m.dtype)
+                    dtype=m.dtype, remat=m.remat)
                 return loss_fn(logits, y, mask)
 
             loss, grads = jax.value_and_grad(compute)(state.params)

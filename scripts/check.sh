@@ -3,7 +3,8 @@
 # build, src/project/build.scala:47-58,78).  Everything a change must pass
 # before merging: syntax, lint, the suite, and the bench contract.
 #
-#   scripts/check.sh           # lint + CPU-mesh suite + smoke bench
+#   scripts/check.sh           # lint + fast-tier suite + smoke bench
+#   scripts/check.sh --full    # the full suite (slow tier included)
 #   scripts/check.sh --tpu     # additionally: perf floors on the real chip
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -15,7 +16,14 @@ echo "== lint (scripts/lint.py) =="
 python scripts/lint.py
 
 echo "== test suite (8-virtual-device CPU mesh) =="
-python -m pytest tests/ -q
+# fast tier by default (pyproject addopts deselects `slow`); --full runs
+# everything, including the XLA-compile-bound parity tests and example/
+# notebook executions
+if [[ " $* " == *" --full "* ]]; then
+    python -m pytest tests/ -q -m ""
+else
+    python -m pytest tests/ -q
+fi
 
 echo "== multichip dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -24,7 +32,7 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 echo "== bench smoke (JSON contract) =="
 python bench.py --smoke
 
-if [[ "${1:-}" == "--tpu" ]]; then
+if [[ " $* " == *" --tpu "* ]]; then
     echo "== perf floors on real TPU =="
     MMLSPARK_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_perf_floor.py -q
 fi

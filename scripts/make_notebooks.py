@@ -1,13 +1,30 @@
 #!/usr/bin/env python
-"""Generate the .ipynb sample notebooks from the canonical examples.
+"""Generate NARRATIVE .ipynb sample notebooks from the canonical examples.
 
 The reference's demo surface is Jupyter notebooks executed by an nbconvert
-harness (tools/notebook/tester/NotebookTestSuite.py:8-56); here the single
-source of truth is the pinned-metric `.py` example (examples/*.py) and the
-notebook is GENERATED from it: module docstring -> markdown cell, body ->
-code cell, a final cell running main().  Deterministic output (no
-timestamps, fixed ids) so `tests/test_notebooks.py` can enforce freshness
-by regenerating and diffing.
+harness (tools/notebook/tester/NotebookTestSuite.py:8-56) whose value is
+the stage-by-stage prose around inspectable intermediate results
+(`notebooks/samples/301 - CIFAR10 CNTK CNN Evaluation.ipynb`).  Here the
+single source of truth stays the pinned-metric `.py` example
+(examples/*.py); the notebook is GENERATED from it as a tutorial:
+
+  * the module docstring becomes the title/introduction markdown;
+  * the module body before `main()` (imports + helpers) becomes a setup
+    code cell;
+  * `main()`'s body is FLATTENED into the notebook's top level and split
+    at its stage-comment boundaries — each top-level comment block
+    becomes a markdown cell, the code under it a code cell, so every
+    stage executes separately and its `log(...)` lines (shapes, metric
+    tables) appear as that cell's own output;
+  * the final `return {...}` becomes `result = {...}` plus a trailing
+    `result` display cell.
+
+Flattening contract (kept by the examples): `main(verbose)` bodies are
+straight-line at their top level — nested defs/withs are fine inside a
+stage, but stage boundaries are top-level comment blocks preceded by a
+blank line.  Deterministic output (no timestamps, fixed ids) so
+`tests/test_notebooks.py` can enforce freshness by regenerating and
+diffing, and kernel-executes the result.
 
     python scripts/make_notebooks.py        # writes notebooks/*.ipynb
 """
@@ -16,6 +33,7 @@ import ast
 import glob
 import json
 import os
+import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -35,29 +53,113 @@ def _cell(kind: str, source: str, idx: int) -> dict:
     return cell
 
 
+def _main_node(tree: ast.Module):
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "main":
+            return node
+    return None
+
+
+def _flatten_main(src_lines: list, main: ast.FunctionDef) -> list:
+    """main()'s body as dedented top-level lines, with the verbose-log
+    plumbing dropped (the setup cell defines `log = print`) and the final
+    `return` rewritten to a `result =` binding."""
+    start = main.body[0].lineno - 1
+    end = main.end_lineno
+    body = src_lines[start:end]
+    out = []
+    # bind main()'s defaulted parameters (except the log plumbing's
+    # `verbose`) so the flattened body sees them
+    args = main.args.args
+    defaults = main.args.defaults
+    for arg, default in zip(args[len(args) - len(defaults):], defaults):
+        if arg.arg != "verbose":
+            out.append(f"{arg.arg} = {ast.unparse(default)}")
+    for line in body:
+        if re.match(r"\s*log = print if verbose", line):
+            continue
+        out.append(line[4:] if line.startswith("    ") else line)
+    # rewrite the trailing top-level `return` (examples end on one)
+    for i in range(len(out) - 1, -1, -1):
+        if out[i].startswith("return "):
+            out[i] = "result = " + out[i][len("return "):]
+            break
+    return out
+
+
+def _split_stages(lines: list) -> list:
+    """[(markdown_prose_or_None, code_lines)] split at top-level comment
+    blocks that follow a blank line (the stage-boundary convention).
+    Indented (nested-block) comments and inline trailing comments stay in
+    their code cell."""
+    segments: list = []
+    cur_prose = None
+    cur_code: list = []
+
+    def flush():
+        nonlocal cur_prose, cur_code
+        if cur_prose is not None or any(ln.strip() for ln in cur_code):
+            segments.append((cur_prose, cur_code))
+        cur_prose, cur_code = None, []
+
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        prev_blank = i == 0 or not lines[i - 1].strip()
+        if line.startswith("# ") and prev_blank:
+            flush()
+            prose: list = []
+            while i < len(lines) and lines[i].startswith("#"):
+                prose.append(lines[i].lstrip("#").strip())
+                i += 1
+            cur_prose = " ".join(p for p in prose if p)
+            continue
+        cur_code.append(line)
+        i += 1
+    flush()
+    return segments
+
+
 def convert(py_path: str) -> dict:
     src = open(py_path).read()
     tree = ast.parse(src)
     doc = ast.get_docstring(tree) or ""
-    # body = source minus the module docstring and the __main__ guard
     lines = src.splitlines()
+    main = _main_node(tree)
+    if main is None:
+        raise ValueError(
+            f"{py_path}: every example must define main(verbose=...) — "
+            "the notebook generator flattens its body into stage cells")
+
+    # module body between the docstring and main(): imports + helpers
     body_start = tree.body[1].lineno - 1 if (
         tree.body and isinstance(tree.body[0], ast.Expr)) else 0
-    body_end = len(lines)
-    for node in tree.body:
-        if (isinstance(node, ast.If)
-                and getattr(getattr(node.test, "left", None), "id", "")
-                == "__name__"):
-            body_end = node.lineno - 1
-    body = "\n".join(lines[body_start:body_end]).strip("\n")
+    setup_end = main.lineno - 1
+    # keep any decorators/comments attached above main out of the cell
+    while setup_end > body_start and not lines[setup_end - 1].strip():
+        setup_end -= 1
+    setup = "\n".join(lines[body_start:setup_end]).strip("\n")
+    setup += "\n\nlog = print  # notebook cells always narrate"
 
     name = os.path.basename(py_path)[:-3]
     title = name.replace("_", " ")
-    cells = [
-        _cell("markdown", f"# {title}\n\n{doc}", 0),
-        _cell("code", body, 1),
-        _cell("code", "result = main()", 2),
-    ]
+    cells = [_cell("markdown", f"# {title}\n\n{doc}", 0),
+             _cell("markdown", "## Setup\n\nImports and local helpers "
+                   "(the pinned example's module body).", 1),
+             _cell("code", setup, 2)]
+    idx = 3
+    for prose, code in _split_stages(_flatten_main(lines, main)):
+        if prose:
+            cells.append(_cell("markdown", prose[0].upper() + prose[1:], idx))
+            idx += 1
+        text = "\n".join(code).strip("\n")
+        if text:
+            cells.append(_cell("code", text, idx))
+            idx += 1
+    cells.append(_cell("markdown", "## Result\n\nThe example's pinned "
+                       "metrics (tests/example_metrics.json gates these "
+                       "values in CI).", idx))
+    cells.append(_cell("code", "result", idx + 1))
     return {
         "nbformat": 4,
         "nbformat_minor": 5,

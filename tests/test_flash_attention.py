@@ -165,6 +165,7 @@ def test_with_lse_offsets_mask_globally():
     assert np.all(np.isfinite(np.asarray(lse2)))
 
 
+@pytest.mark.slow
 def test_transformer_lm_flash_matches_dense():
     from mmlspark_tpu.models.definitions import build_model
     cfg = {"vocab_size": 64, "d_model": 64, "n_heads": 4, "n_layers": 2,

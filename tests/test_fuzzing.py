@@ -57,6 +57,18 @@ def _conv_bundle():
         (1, 8, 8, 3), seed=0)
 
 
+def _lm_bundle():
+    import jax
+
+    from mmlspark_tpu.models import ModelBundle
+    from mmlspark_tpu.models.definitions import build_model
+    lm = build_model("TransformerLM", {
+        "vocab_size": 16, "d_model": 16, "n_heads": 2, "n_layers": 1,
+        "max_len": 12, "dtype": "float32"})
+    variables = lm.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+    return ModelBundle.from_module(lm, variables)
+
+
 def _scored_table(seed=0, n=24):
     """A classification-scored table with the mml score metadata set (what
     evaluators consume downstream of any classifier)."""
@@ -82,7 +94,7 @@ def _scored_table(seed=0, n=24):
 
 # stage-name -> () -> (instance, table or None)
 def _fixtures():
-    from mmlspark_tpu import Pipeline
+    from mmlspark_tpu import DataTable, Pipeline
     from mmlspark_tpu.feature import (AssembleFeatures, Featurize, HashingTF,
                                       IDF, NGram, StopWordsRemover,
                                       TextFeaturizer, Tokenizer, Word2Vec)
@@ -96,6 +108,7 @@ def _fixtures():
                                  OneVsRest, RandomForestClassifier,
                                  RandomForestRegressor, TrainClassifier,
                                  TrainRegressor)
+    from mmlspark_tpu.models.generate import TextGenerator
     from mmlspark_tpu.models.tpu_model import TPUModel
     from mmlspark_tpu.train import TrainerConfig
     from mmlspark_tpu.train.learner import TPULearner
@@ -193,6 +206,11 @@ def _fixtures():
         "TPUModel": lambda: (
             TPUModel(_tiny_bundle(), inputCol="features",
                      miniBatchSize=8), ml),
+        "TextGenerator": lambda: (
+            TextGenerator(_lm_bundle(), inputCol="prompt",
+                          maxNewTokens=2),
+            DataTable({"prompt": np.tile(np.arange(4, dtype=np.int32),
+                                         (6, 1))})),
         "ImageTransformer": lambda: (
             ImageTransformer().resize(4, 4), img),
         "UnrollImage": lambda: (UnrollImage(), img),

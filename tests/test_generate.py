@@ -24,6 +24,7 @@ def lm_bundle():
     return ModelBundle.from_module(lm, variables)
 
 
+@pytest.mark.slow
 def test_greedy_matches_naive_recompute(lm_bundle):
     """The whole point of the cache: same tokens as the O(N*S^2) oracle."""
     module = lm_bundle.module()
@@ -116,6 +117,7 @@ def test_text_generator_stage(lm_bundle, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_generates_from_pipeline_trained_bundle():
     """A bundle that came out of pipeline-parallel training (stacked tree
     unstacked back to TransformerLM) must decode like any other — the

@@ -132,6 +132,7 @@ def test_tpu_model_requires_bundle():
         TPUModel(inputCol="x").transform(t)
 
 
+@pytest.mark.slow
 def test_transformer_lm_remat_matches_non_remat():
     """remat=True changes memory scheduling, never values: forward AND
     gradients must match the plain model exactly (same params)."""

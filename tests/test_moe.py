@@ -110,6 +110,7 @@ def test_aux_loss_prefers_balance():
     assert float(aux_c) > float(aux_b)
 
 
+@pytest.mark.slow
 def test_moe_transformer_lm_trains():
     from mmlspark_tpu.models.definitions import build_model
 

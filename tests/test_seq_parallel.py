@@ -57,6 +57,7 @@ def test_seq_parallel_matches_dense(qkv, seq_mesh, impl, causal):
 
 @pytest.mark.budget(60)  # compiling the scan-transpose of the ring VJP
 # on the CPU mesh is a fixed ~25-40s cost (load-sensitive)
+@pytest.mark.slow
 def test_ring_attention_gradients_match(qkv, seq_mesh):
     q, k, v = qkv
 
@@ -76,6 +77,7 @@ def test_ring_attention_gradients_match(qkv, seq_mesh):
             np.abs(np.asarray(a) - np.asarray(b)).max()
 
 
+@pytest.mark.slow
 def test_ring_flash_gradients_match(qkv, seq_mesh):
     """ring_flash_attention's custom VJP (second ring pass, dK/dV riding
     with their shards, global-LSE block grads) vs the dense VJP."""

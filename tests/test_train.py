@@ -148,6 +148,7 @@ def test_config_validation_and_roundtrip():
     assert cfg2.mesh == cfg.mesh and cfg2.lr_schedule == "warmup_cosine"
 
 
+@pytest.mark.slow
 def test_trainer_adds_model_sown_aux_losses():
     """aux_loss_weight folds flax 'losses'-collection terms (the MoE
     load-balance loss) into the Trainer objective; weight 0 ignores them."""

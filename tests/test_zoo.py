@@ -96,6 +96,7 @@ def test_download_unknown_model(tmp_path, source_repo):
 
 @pytest.mark.budget(60)  # materializes + packs several real nets
 # (ResNet init dominates); ~25-35s, load-sensitive
+@pytest.mark.slow
 def test_builtin_repo(tmp_path):
     include = ["ConvNet", "ResNet18", "MLP"]
     repo = create_builtin_repo(str(tmp_path / "zoo"), include=include)
@@ -128,6 +129,7 @@ def test_resnet50_bottleneck_shapes():
     assert inter["stage4"][0].shape == (1, 7, 7, 2048)
 
 
+@pytest.mark.slow
 def test_fine_tune_publish_serve_download_featurize(tmp_path):
     """The full zoo loop over a real HTTP server: fine-tune (TPULearner) ->
     publish (LocalRepo.add_model + export_manifest) -> download via

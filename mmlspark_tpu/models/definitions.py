@@ -250,9 +250,11 @@ class TransformerBlock(nn.Module):
                          name="proj")(o.reshape(b, s, self.d_model))
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.mlp_impl == "moe":
-            # sparse conditional compute: Switch top-1 experts (ops/moe.py);
-            # the expert dimension shards over `expert_axis` via
-            # expert_parallel_rules (GSPMD EP)
+            # sparse conditional compute: Switch/GShard experts
+            # (ops/moe.py); the expert dimension shards over
+            # `expert_axis` via expert_parallel_rules (GSPMD EP).
+            # models/generate.py::_mlp mirrors this construction for
+            # KV-cache decode — keep the two in sync
             from mmlspark_tpu.ops.moe import MoEMLP
             return x + MoEMLP(self.d_model, n_experts=self.n_experts,
                               mlp_ratio=self.mlp_ratio, dtype=self.dtype,

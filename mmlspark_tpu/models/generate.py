@@ -63,11 +63,39 @@ def _dense(p: dict, x: jax.Array, dtype) -> jax.Array:
             + p["bias"].astype(dtype))
 
 
-def _block_with_cache(bp: dict, x: jax.Array, k_cache: jax.Array,
-                      v_cache: jax.Array, pos, n_heads: int, dtype):
+def _mlp(module, bp: dict, h2: jax.Array, dtype) -> jax.Array:
+    """The block's MLP half over normalized activations h2 (B, S, D).
+
+    MoE blocks re-apply the REAL MoEMLP flax module against the block's
+    own params (same construction as TransformerBlock's, keep in sync —
+    definitions.py), so routing math is never duplicated here.
+    Per-segment routing matches training semantics exactly at prefill
+    (same token group, same capacity arithmetic).  Decode steps route
+    the step's BATCH as one group, so under capacity pressure routing
+    can diverge from the full-sequence recompute in either direction
+    (keep a token it would drop, or drop one it would keep), and a
+    row's generations can depend on its co-batched rows — the capacity
+    drop is a batch-level construct a stepwise decoder cannot reproduce.
+    Tests pin prefill parity exactly and greedy parity in the drop-free
+    regime (moe_group_size=1)."""
+    if module.mlp_impl == "moe":
+        from mmlspark_tpu.ops.moe import MoEMLP
+        return MoEMLP(module.d_model, n_experts=module.n_experts,
+                      mlp_ratio=module.mlp_ratio, dtype=dtype,
+                      expert_axis=module.expert_axis,
+                      router_k=module.moe_router_k,
+                      group_size=module.moe_group_size).apply(
+            {"params": bp["moe"]}, h2)
+    return _dense(bp["mlp_down"], jax.nn.gelu(
+        _dense(bp["mlp_up"], h2, dtype)), dtype)
+
+
+def _block_with_cache(module, bp: dict, x: jax.Array, k_cache: jax.Array,
+                      v_cache: jax.Array, pos, dtype):
     """One TransformerBlock over a token segment starting at `pos`,
     reading/writing the (B, max_len, H, Dh) caches.  Works for prefill
     (S = prompt length, pos = 0) and decode (S = 1, traced pos) alike."""
+    n_heads = module.n_heads
     b, s, d = x.shape
     dh = d // n_heads
     h = _ln(bp["LayerNorm_0"], x, dtype)
@@ -90,24 +118,23 @@ def _block_with_cache(bp: dict, x: jax.Array, k_cache: jax.Array,
     o = jnp.einsum("bhql,blhd->bqhd", w, v_cache.astype(jnp.float32))
     x = x + _dense(bp["proj"], o.reshape(b, s, d).astype(dtype), dtype)
     h2 = _ln(bp["LayerNorm_1"], x, dtype)
-    mlp = _dense(bp["mlp_down"], jax.nn.gelu(
-        _dense(bp["mlp_up"], h2, dtype)), dtype)
-    return x + mlp, k_cache, v_cache
+    return x + _mlp(module, bp, h2, dtype), k_cache, v_cache
 
 
 def _forward_with_cache(params: dict, tokens: jax.Array, caches: list,
-                        pos, n_layers: int, n_heads: int, dtype):
+                        pos, module):
     """Logits (B, S, V) for a token segment at `pos`, updating the caches."""
+    dtype = module.dtype
     s = tokens.shape[1]
     positions = pos + jnp.arange(s)
     emb = (params["tok_embed"]["embedding"][tokens]
            + params["pos_embed"]["embedding"][positions][None])
     x = emb.astype(dtype)
     new_caches = []
-    for i in range(n_layers):
+    for i in range(module.n_layers):
         x, kc, vc = _block_with_cache(
-            params[f"block{i}_w"], x, caches[i][0], caches[i][1], pos,
-            n_heads, dtype)
+            module, params[f"block{i}_w"], x, caches[i][0], caches[i][1],
+            pos, dtype)
         new_caches.append((kc, vc))
     # same dtype discipline as TransformerLM: final norm + head run in the
     # model's compute dtype, logits emitted float32
@@ -121,12 +148,9 @@ def _check_generatable(module) -> None:
         raise ValueError(
             f"generate() decodes TransformerLM models, got "
             f"{type(module).__name__}")
-    if module.mlp_impl != "dense":
-        raise ValueError(
-            "generate() supports dense MLP blocks; MoE decode (per-step "
-            "routing) is not implemented")
     # any attention EXECUTION strategy trains the same weights; decode
-    # always attends q against the cache, so attn_impl needs no check
+    # always attends q against the cache, so attn_impl needs no check.
+    # MoE blocks decode too: _mlp re-applies the real MoEMLP module.
 
 
 def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
@@ -172,14 +196,14 @@ def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
                    jnp.zeros((b, module.max_len, n_heads, dh), dtype))
                   for _ in range(n_layers)]
         logits, caches = _forward_with_cache(
-            params, prompts, caches, 0, n_layers, n_heads, dtype)
+            params, prompts, caches, 0, module)
         key, sub = jax.random.split(key)
         tok = sample(logits[:, -1], sub)
 
         def step(carry, step_key):
             tok, pos, caches = carry
             logits, caches = _forward_with_cache(
-                params, tok[:, None], caches, pos, n_layers, n_heads, dtype)
+                params, tok[:, None], caches, pos, module)
             nxt = sample(logits[:, 0], step_key)
             return (nxt, pos + 1, caches), tok
 
@@ -216,6 +240,10 @@ class TextGenerator(Transformer):
     shape class — the same static-shape discipline as
     vision/transformer.py's ragged grouping) and decoded through the
     jit-once KV-cache program; output rows align with input rows.
+
+    MoE models: each decode step routes its batch as one capacity-limited
+    group, so a row's generations can depend on which rows share its
+    batch (dense models are row-independent) — see `_mlp`.
     """
 
     inputCol = Param(None, "column of int token-id prompt arrays",

@@ -44,6 +44,11 @@ PIN_EXTRACTORS = {
         "accuracy": _r(out["accuracy"]),
         "feature_dim": int(out["feature_dim"]),
     },
+    "example_401_lm_generation.py": lambda out: {
+        "final_loss": _r(out["final_loss"]),
+        "continuation_accuracy": _r(out["continuation_accuracy"]),
+        "n_generated": int(out["n_generated"]),
+    },
 }
 
 

@@ -88,3 +88,11 @@ def test_example_302_image_pipeline():
     assert out["n_images"] == 96
     assert out["feature_dim"] == 128  # ResNetDigits bottleneck pool node
     assert out["accuracy"] > 0.8
+
+
+@pytest.mark.slow
+def test_example_401_lm_generation():
+    out = _run("example_401_lm_generation.py")
+    # the cycle rule is fully learnable; greedy continuations follow it
+    assert out["continuation_accuracy"] > 0.9
+    assert out["n_generated"] == 48

@@ -44,8 +44,7 @@ from jax import lax
 
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Transformer
-from mmlspark_tpu.core.table import DataTable
-from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
+from mmlspark_tpu.models.bundle import load_bundle, save_bundle
 
 NEG_INF = -1e30
 

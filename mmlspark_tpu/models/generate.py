@@ -8,9 +8,10 @@ recipe:
 
   * **prefill**: one full forward over the (fixed-length) prompt writes
     every layer's K/V into a max_len-sized cache and yields the first
-    sampled token.  Attention here is the ordinary causal batched matmul
-    (XLA fuses it; prompt lengths at scoring scale don't need the flash
-    kernel's memory discipline).
+    sampled token.  Attention is the ordinary causal batched matmul for
+    short prompts (XLA fuses it) and the pallas flash kernel from
+    _PREFILL_FLASH_MIN tokens up — a long prompt must not materialize
+    the O(P^2) score tensor the flash path exists to avoid.
   * **decode**: a `lax.scan` over step count; each step embeds ONE token,
     updates the caches via `lax.dynamic_update_slice` at a traced
     position, and attends the single query against the full cache under a
@@ -25,7 +26,9 @@ over the SAME flax param tree (models/definitions.py names: qkv / proj /
 mlp_up / mlp_down / LayerNorm_0/1), so any trained TransformerLM bundle —
 including one trained through pipeline parallelism and converted back —
 generates without re-exporting weights.  Parity with recompute-everything
-decoding is pinned exactly at float32 by tests/test_generate.py.  One
+decoding is pinned exactly at float32 by tests/test_generate.py for
+prompts below _PREFILL_FLASH_MIN (the flash prefill's online softmax can
+reassociate near-tie logits above it).  One
 deliberate dtype difference: decode attention accumulates QK^T / PV in
 float32 (the single-query step is bandwidth-bound, so the extra precision
 is free), while the training forward's einsums run in the model dtype —
@@ -89,6 +92,13 @@ def _mlp(module, bp: dict, h2: jax.Array, dtype) -> jax.Array:
         _dense(bp["mlp_up"], h2, dtype)), dtype)
 
 
+_PREFILL_FLASH_MIN = 512  # prompt length from which prefill attention
+# runs the pallas flash kernel instead of the masked dense matmul: long
+# prompts would otherwise materialize an O(P^2) score tensor — exactly
+# the blow-up the flash path exists to avoid.  Short prompts stay on the
+# dense path, whose f32 softmax is bit-stable for the exact-parity tests.
+
+
 def _block_with_cache(module, bp: dict, x: jax.Array, k_cache: jax.Array,
                       v_cache: jax.Array, pos, dtype):
     """One TransformerBlock over a token segment starting at `pos`,
@@ -106,15 +116,26 @@ def _block_with_cache(module, bp: dict, x: jax.Array, k_cache: jax.Array,
                                        (0, pos, 0, 0))
     v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
                                        (0, pos, 0, 0))
-    max_len = k_cache.shape[1]
-    scores = jnp.einsum("bqhd,blhd->bhql", q.astype(jnp.float32),
-                        k_cache.astype(jnp.float32)) * dh ** -0.5
-    # global causal mask: query at pos+i sees cache slots 0..pos+i
-    q_pos = pos + jnp.arange(s)
-    visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]     # (S, L)
-    scores = jnp.where(visible[None, None], scores, NEG_INF)
-    w = jax.nn.softmax(scores, axis=-1)
-    o = jnp.einsum("bhql,blhd->bqhd", w, v_cache.astype(jnp.float32))
+    if s >= _PREFILL_FLASH_MIN and isinstance(pos, int) and pos == 0:
+        # long-prompt PREFILL ONLY (static pos 0: at decode, pos is a
+        # tracer): attention against the cache is then exactly causal
+        # self-attention over the segment, so the flash kernel
+        # (O(block^2) memory, fwd-only) computes it without ever
+        # materializing the (S, S) scores.  A long segment at pos > 0
+        # would need the cached prefix too — it takes the dense
+        # full-cache path below
+        from mmlspark_tpu.ops.flash_attention import flash_attention
+        o = flash_attention(q, k, v, causal=True)
+    else:
+        max_len = k_cache.shape[1]
+        scores = jnp.einsum("bqhd,blhd->bhql", q.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) * dh ** -0.5
+        # global causal mask: query at pos+i sees cache slots 0..pos+i
+        q_pos = pos + jnp.arange(s)
+        visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (S, L)
+        scores = jnp.where(visible[None, None], scores, NEG_INF)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhql,blhd->bqhd", w, v_cache.astype(jnp.float32))
     x = x + _dense(bp["proj"], o.reshape(b, s, d).astype(dtype), dtype)
     h2 = _ln(bp["LayerNorm_1"], x, dtype)
     return x + _mlp(module, bp, h2, dtype), k_cache, v_cache

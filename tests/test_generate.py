@@ -171,3 +171,43 @@ def test_generates_from_pipeline_trained_bundle():
     got = generate(module, bundle.variables, prompts, max_new_tokens=8)
     ref = naive_generate(module, bundle.variables, prompts, 8)
     np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.slow
+def test_long_prompt_prefill_uses_flash_and_matches_dense():
+    """Prefill at >= _PREFILL_FLASH_MIN tokens routes through the flash
+    kernel (no O(P^2) score tensor); its logits match the module's dense
+    forward to online-softmax rounding, the public jit-once generation
+    program runs end to end at that prompt length, and no dense fallback
+    fires (which would silently re-materialize the scores)."""
+    import mmlspark_tpu.ops.flash_attention as fa
+    from mmlspark_tpu.models.generate import (_PREFILL_FLASH_MIN,
+                                              _forward_with_cache)
+
+    P = _PREFILL_FLASH_MIN
+    cfg = {"vocab_size": 32, "d_model": 16, "n_heads": 2, "n_layers": 1,
+           "max_len": P + 8, "dtype": "float32"}
+    lm = build_model("TransformerLM", cfg)
+    toks = jnp.asarray(np.random.default_rng(7).integers(0, 32, (1, P)),
+                       jnp.int32)
+    variables = lm.init(jax.random.key(0), toks)
+    ref = np.asarray(lm.apply(variables, toks))
+    caches = [(jnp.zeros((1, P + 8, 2, 8), jnp.float32),
+               jnp.zeros((1, P + 8, 2, 8), jnp.float32))]
+    got, new_caches = _forward_with_cache(variables["params"], toks,
+                                          caches, 0, lm)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+    # the cache was still written for the decode steps that follow
+    assert float(jnp.abs(new_caches[0][0][0, :P]).sum()) > 0
+    assert float(jnp.abs(new_caches[0][0][0, P:]).sum()) == 0
+
+    # the PUBLIC path: the compiled prefill+scan program at a long prompt,
+    # with the dense-fallback warning set untouched (flash really ran)
+    before = set(fa._warned_fallbacks)
+    fn = make_generate_fn(lm, P, 8)
+    out = np.asarray(fn(variables, toks, jax.random.key(0)))
+    assert out.shape == (1, P + 8)
+    np.testing.assert_array_equal(out[:, :P], np.asarray(toks))
+    assert (out >= 0).all() and (out < 32).all()
+    assert set(fa._warned_fallbacks) == before, (
+        "flash prefill silently fell back to dense")

@@ -281,10 +281,27 @@ class TextGenerator(Transformer):
         super().__init__(**kwargs)
         self._bundle = bundle
         self._compiled: dict = {}
+        self._mesh = None
+        self._device_vars: dict = {}   # per-mesh replicated weights
 
     def set_bundle(self, bundle: "ModelBundle") -> "TextGenerator":
         self._bundle = bundle
         self._compiled.clear()
+        return self
+
+    def set_mesh(self, mesh) -> "TextGenerator":
+        """Generate data-parallel over a device mesh: prompt batches are
+        sharded along the 'data' axis (zero-padded to whole shards via
+        pad_to_multiple — the TPUModel batching discipline) and weights
+        are replicated once per mesh.  Dense decode is purely batch-
+        parallel (no collectives in the scan; meshed output equals
+        single-device output, test-pinned).  MoE decode routes each step
+        cross-batch, so its dispatch spans the mesh AND the zero-pad
+        rows join the capacity groups — one more instance of the MoE
+        batch-composition coupling documented on this class."""
+        self._mesh = mesh
+        self._compiled.clear()
+        self._device_vars = {}
         return self
 
     @property
@@ -313,9 +330,27 @@ class TextGenerator(Transformer):
             by_len.setdefault(len(r), []).append(i)
         for plen, idxs in sorted(by_len.items()):
             fn = self._fn_for(plen)
-            prompts = jnp.asarray(np.stack([rows[i] for i in idxs]))
+            prompts = np.stack([rows[i] for i in idxs])
+            variables = self._bundle.variables
+            if self._mesh is not None:
+                from mmlspark_tpu.parallel.bridge import (pad_to_multiple,
+                                                          replicate_tree)
+                from mmlspark_tpu.parallel.mesh import batch_sharding
+                data = self._mesh.shape["data"]
+                padded = -(-len(idxs) // data) * data
+                prompts, _ = pad_to_multiple(prompts, padded)
+                # one straight-to-sharded transfer (no default-device hop);
+                # weights replicate once per mesh (the TPUModel discipline)
+                prompts = jax.device_put(prompts,
+                                         batch_sharding(self._mesh))
+                if self._mesh not in self._device_vars:
+                    self._device_vars[self._mesh] = replicate_tree(
+                        variables, self._mesh)
+                variables = self._device_vars[self._mesh]
+            else:
+                prompts = jnp.asarray(prompts)
             key = jax.random.key(self.seed)
-            got = np.asarray(fn(self._bundle.variables, prompts, key))
+            got = np.asarray(fn(variables, prompts, key))
             for j, i in enumerate(idxs):
                 out[i] = got[j]
         if n and len(by_len) == 1:
@@ -334,6 +369,8 @@ class TextGenerator(Transformer):
         self._bundle = (load_bundle(f"{path}/bundle")
                         if os.path.exists(f"{path}/bundle") else None)
         self._compiled = {}
+        self._mesh = None
+        self._device_vars = {}
 
 
 def naive_generate(module, variables, prompts, max_new_tokens: int) -> np.ndarray:

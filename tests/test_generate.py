@@ -211,3 +211,23 @@ def test_long_prompt_prefill_uses_flash_and_matches_dense():
     assert (out >= 0).all() and (out < 32).all()
     assert set(fa._warned_fallbacks) == before, (
         "flash prefill silently fell back to dense")
+
+
+def test_text_generator_over_mesh_matches_single_device(lm_bundle):
+    """Mesh-sharded generation (batch over 'data', zero-padded to whole
+    shards) must produce exactly the single-device tokens for dense
+    models — batch parallelism cannot change any row's decode."""
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=8))
+    rows = np.empty(5, object)  # 5 rows of length 4: pads to 8 shards
+    for i in range(5):
+        rows[i] = (np.arange(4, dtype=np.int32) + i) % 32
+    table = DataTable({"prompt": rows})
+    single = TextGenerator(lm_bundle, inputCol="prompt", outputCol="out",
+                           maxNewTokens=5).transform(table)["out"]
+    meshed = TextGenerator(lm_bundle, inputCol="prompt", outputCol="out",
+                           maxNewTokens=5).set_mesh(mesh).transform(
+        table)["out"]
+    for a, b in zip(single, meshed):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -19,7 +19,8 @@ recipe:
     is one compiled program — no per-step dispatch, no retracing, no
     Python in the loop.
   * **sampling**: greedy (temperature 0) or temperature-scaled
-    categorical, decided at trace time.
+    categorical over the top-k / top-p (nucleus) filtered distribution,
+    decided at trace time (`filter_logits`).
 
 The decoder re-implements the TransformerLM block math as pure functions
 over the SAME flax param tree (models/definitions.py names: qkv / proj /
@@ -173,14 +174,44 @@ def _check_generatable(module) -> None:
     # MoE blocks decode too: _mlp re-applies the real MoEMLP module.
 
 
+def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jax.Array:
+    """Mask (B, V) logits to the top-k entries and/or the top-p nucleus.
+
+    top_k keeps the k highest-logit tokens per row; top_p keeps the
+    smallest prefix of the probability-sorted vocabulary whose cumulative
+    probability reaches p (the first token always survives, so the
+    distribution never empties).  Everything else becomes NEG_INF —
+    static-shape, sort-based, jit-friendly."""
+    out = logits.astype(jnp.float32)
+    if top_k is not None and top_k < out.shape[-1]:
+        kth = jax.lax.top_k(out, top_k)[0][..., -1:]
+        out = jnp.where(out >= kth, out, NEG_INF)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(out, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # a token is kept while the mass BEFORE it is < p (so the first
+        # token is always kept); find the smallest kept logit
+        keep = (cum - probs) < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        out = jnp.where(out >= cutoff, out, NEG_INF)
+    return out
+
+
 def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
-                     temperature: float = 0.0):
+                     temperature: float = 0.0,
+                     top_k: Optional[int] = None,
+                     top_p: Optional[float] = None):
     """A jitted `(variables, prompts (B, P) int32, rng_key) -> (B, P+N)`
     generation program for one (prompt_len, max_new_tokens) shape class.
 
     Compiled once per shape class; TextGenerator caches these.  The prompt
     must fit the model: prompt_len + max_new_tokens <= max_len (position
-    embeddings are the budget)."""
+    embeddings are the budget).  Sampling is greedy at temperature 0;
+    otherwise temperature-scaled categorical over the top_k / top_p
+    (nucleus) filtered distribution (`filter_logits`)."""
     _check_generatable(module)
     if prompt_len < 1:
         raise ValueError("prompt_len must be >= 1")
@@ -190,6 +221,10 @@ def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
         raise ValueError(
             f"prompt_len ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's max_len ({module.max_len})")
+    if top_k is not None and top_k < 1:
+        raise ValueError("top_k must be >= 1")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError("top_p must be in (0, 1]")
     n_layers, n_heads = module.n_layers, module.n_heads
     dh = module.d_model // n_heads
     dtype = module.dtype
@@ -198,9 +233,12 @@ def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
     def sample(logits, key):
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits.astype(jnp.float32) / temperature, axis=-1
-        ).astype(jnp.int32)
+        # temperature first, then filter: the nucleus mass is measured on
+        # the distribution actually sampled (the standard ordering)
+        filtered = filter_logits(
+            logits.astype(jnp.float32) / temperature, top_k, top_p)
+        return jax.random.categorical(key, filtered,
+                                      axis=-1).astype(jnp.int32)
 
     @jax.jit
     def generate_fn(variables, prompts, key):
@@ -242,12 +280,13 @@ def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
 
 def generate(module, variables, prompts, max_new_tokens: int,
              temperature: float = 0.0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None) -> np.ndarray:
     """One-shot convenience wrapper around `make_generate_fn` (which is
     the jit-once API for repeated calls)."""
     prompts = jnp.asarray(prompts, jnp.int32)
     fn = make_generate_fn(module, prompts.shape[1], max_new_tokens,
-                          temperature)
+                          temperature, top_k=top_k, top_p=top_p)
     key = rng if rng is not None else jax.random.key(0)
     return np.asarray(fn(variables, prompts, key))
 
@@ -275,6 +314,12 @@ class TextGenerator(Transformer):
     temperature = Param(0.0, "0 = greedy; > 0 samples with this "
                         "temperature", ptype=float,
                         validator=lambda v: v >= 0)
+    topK = Param(0, "sample only among the k most probable tokens "
+                 "(0 = off; ignored when greedy)", ptype=int,
+                 validator=lambda v: v >= 0)
+    topP = Param(1.0, "nucleus sampling: smallest probability mass to "
+                 "sample within (1.0 = off; ignored when greedy)",
+                 ptype=float, validator=lambda v: 0 < v <= 1)
     seed = Param(0, "sampling seed (ignored when greedy)", ptype=int)
 
     def __init__(self, bundle: Optional["ModelBundle"] = None, **kwargs):
@@ -309,11 +354,17 @@ class TextGenerator(Transformer):
         return self._bundle
 
     def _fn_for(self, prompt_len: int):
-        key = (prompt_len, self.maxNewTokens, self.temperature)
+        # greedy ignores the filters: normalize them out of the cache key
+        # so flipping topK/topP at temperature 0 never recompiles
+        sampling = self.temperature > 0
+        top_k = (self.topK or None) if sampling else None
+        top_p = self.topP if sampling and self.topP < 1.0 else None
+        key = (prompt_len, self.maxNewTokens, self.temperature,
+               top_k, top_p)
         if key not in self._compiled:
             self._compiled[key] = make_generate_fn(
                 self._bundle.module(), prompt_len, self.maxNewTokens,
-                self.temperature)
+                self.temperature, top_k=top_k, top_p=top_p)
         return self._compiled[key]
 
     def transform(self, table: "DataTable") -> "DataTable":

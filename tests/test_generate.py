@@ -231,3 +231,72 @@ def test_text_generator_over_mesh_matches_single_device(lm_bundle):
         table)["out"]
     for a, b in zip(single, meshed):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_filter_logits_top_k_and_top_p():
+    from mmlspark_tpu.models.generate import NEG_INF, filter_logits
+
+    logits = jnp.asarray([[3.0, 1.0, 2.0, 0.0, -1.0]])
+    k2 = np.asarray(filter_logits(logits, top_k=2))
+    assert (k2[0, [0, 2]] > NEG_INF / 2).all()        # two best kept
+    assert (k2[0, [1, 3, 4]] <= NEG_INF / 2).all()    # rest masked
+    # nucleus: probs ~ [.66, .09, .24, .03, .01]; p=.7 keeps {0} then
+    # needs 2 to reach .7 -> keeps the smallest prefix covering p
+    p7 = np.asarray(filter_logits(logits, top_p=0.7))
+    assert p7[0, 0] > NEG_INF / 2 and p7[0, 2] > NEG_INF / 2
+    assert (p7[0, [1, 3, 4]] <= NEG_INF / 2).all()
+    # a tiny p still keeps the argmax (never an empty distribution)
+    p_tiny = np.asarray(filter_logits(logits, top_p=1e-6))
+    assert p_tiny[0, 0] > NEG_INF / 2
+    assert (p_tiny[0, 1:] <= NEG_INF / 2).all()
+    # off switches are identity
+    np.testing.assert_array_equal(
+        np.asarray(filter_logits(logits, top_k=None, top_p=None)),
+        np.asarray(logits, np.float32))
+
+
+def test_top_k_one_equals_greedy(lm_bundle):
+    """top_k=1 collapses temperature sampling to greedy exactly — the
+    end-to-end pin that the filter really gates the sampler."""
+    module = lm_bundle.module()
+    prompts = jnp.asarray([[1, 2, 3, 4], [7, 7, 2, 9]], jnp.int32)
+    greedy_fn = make_generate_fn(module, 4, 10, temperature=0.0)
+    k1_fn = make_generate_fn(module, 4, 10, temperature=1.7, top_k=1)
+    a = np.asarray(greedy_fn(lm_bundle.variables, prompts, jax.random.key(0)))
+    b = np.asarray(k1_fn(lm_bundle.variables, prompts, jax.random.key(5)))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_top_p_sampling_valid_and_validated(lm_bundle):
+    module = lm_bundle.module()
+    fn = make_generate_fn(module, 4, 8, temperature=1.0, top_p=0.8)
+    out = np.asarray(fn(lm_bundle.variables,
+                        jnp.asarray([[1, 2, 3, 4]], jnp.int32),
+                        jax.random.key(0)))
+    assert out.shape == (1, 12)
+    assert (out >= 0).all() and (out < 32).all()
+    with pytest.raises(ValueError, match="top_k"):
+        make_generate_fn(module, 4, 2, temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        make_generate_fn(module, 4, 2, temperature=1.0, top_p=0.0)
+
+
+def test_text_generator_sampling_params_end_to_end(lm_bundle):
+    """topK/topP flow through the stage: defaults (0 / 1.0) normalize to
+    off, active values produce valid sampled rows, and greedy ignores
+    the filters without recompiling per filter value."""
+    rows = np.stack([np.asarray([1, 2, 3, 4], np.int32)] * 2)
+    table = DataTable({"prompt": rows})
+    sampled = TextGenerator(lm_bundle, inputCol="prompt", outputCol="out",
+                            maxNewTokens=6, temperature=0.9, topK=5,
+                            topP=0.9).transform(table)["out"]
+    assert sampled.shape == (2, 10)
+    assert (sampled >= 0).all() and (sampled < 32).all()
+    greedy = TextGenerator(lm_bundle, inputCol="prompt", outputCol="out",
+                           maxNewTokens=6, topK=7)  # filters ignored
+    a = greedy.transform(table)["out"]
+    assert len(greedy._compiled) == 1
+    greedy.set_params(topK=3)
+    b = greedy.transform(table)["out"]
+    assert len(greedy._compiled) == 1  # same normalized cache key
+    np.testing.assert_array_equal(a, b)

@@ -45,6 +45,7 @@ def test_single_new_token(lm_bundle):
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.slow
 def test_temperature_sampling_reproducible_and_varied(lm_bundle):
     module = lm_bundle.module()
     fn = make_generate_fn(module, prompt_len=4, max_new_tokens=16,
@@ -70,6 +71,7 @@ def test_budget_validation(lm_bundle):
            jax.random.key(0))
 
 
+@pytest.mark.slow
 def test_bf16_decode_logits_match_module_forward():
     """The shipped default dtype: the decode path's prefill logits must
     agree with module.apply to bfloat16 rounding (decode accumulates
@@ -89,6 +91,7 @@ def test_bf16_decode_logits_match_module_forward():
     np.testing.assert_allclose(np.asarray(got), ref, rtol=0.05, atol=0.05)
 
 
+@pytest.mark.slow
 def test_text_generator_stage(lm_bundle, tmp_path):
     """Ragged prompt lengths, row alignment, and the persistence fuzz
     contract (save -> load -> identical transform)."""
@@ -113,6 +116,7 @@ def test_text_generator_stage(lm_bundle, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_moe_decode_prefill_matches_module_forward():
     """MoE blocks decode: the prefill forward re-applies the REAL MoEMLP
     per layer, so its logits equal module.apply exactly (same token group,
@@ -213,6 +217,7 @@ def test_long_prompt_prefill_uses_flash_and_matches_dense():
         "flash prefill silently fell back to dense")
 
 
+@pytest.mark.slow
 def test_text_generator_over_mesh_matches_single_device(lm_bundle):
     """Mesh-sharded generation (batch over 'data', zero-padded to whole
     shards) must produce exactly the single-device tokens for dense
@@ -255,6 +260,7 @@ def test_filter_logits_top_k_and_top_p():
         np.asarray(logits, np.float32))
 
 
+@pytest.mark.slow
 def test_top_k_one_equals_greedy(lm_bundle):
     """top_k=1 collapses temperature sampling to greedy exactly — the
     end-to-end pin that the filter really gates the sampler."""
@@ -267,6 +273,7 @@ def test_top_k_one_equals_greedy(lm_bundle):
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_top_p_sampling_valid_and_validated(lm_bundle):
     module = lm_bundle.module()
     fn = make_generate_fn(module, 4, 8, temperature=1.0, top_p=0.8)

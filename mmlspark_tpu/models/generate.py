@@ -200,6 +200,40 @@ def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
     return out
 
 
+def _validate_decode_args(module, prompt_len: int,
+                          max_new_tokens: int) -> None:
+    """Shared budget checks for both decode entry points (sampler + beam)."""
+    _check_generatable(module)
+    if prompt_len < 1:
+        raise ValueError("prompt_len must be >= 1")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if prompt_len + max_new_tokens > module.max_len:
+        raise ValueError(
+            f"prompt_len ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's max_len ({module.max_len})")
+
+
+def _prefill(params, prompts, module, prompt_len: int):
+    """Allocate zero caches, run the prompt forward, return (last-position
+    logits, caches).  Raises at trace time on a prompt-length mismatch — a
+    compiled fn reused at the wrong length would decode against
+    never-written cache slots."""
+    if prompts.shape[1] != prompt_len:
+        raise ValueError(
+            f"prompts have length {prompts.shape[1]} but this compiled "
+            f"decode program was built for prompt_len={prompt_len}")
+    b = prompts.shape[0]
+    dh = module.d_model // module.n_heads
+    caches = [(jnp.zeros((b, module.max_len, module.n_heads, dh),
+                         module.dtype),
+               jnp.zeros((b, module.max_len, module.n_heads, dh),
+                         module.dtype))
+              for _ in range(module.n_layers)]
+    logits, caches = _forward_with_cache(params, prompts, caches, 0, module)
+    return logits[:, -1], caches
+
+
 def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
                      temperature: float = 0.0,
                      top_k: Optional[int] = None,
@@ -212,22 +246,11 @@ def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
     embeddings are the budget).  Sampling is greedy at temperature 0;
     otherwise temperature-scaled categorical over the top_k / top_p
     (nucleus) filtered distribution (`filter_logits`)."""
-    _check_generatable(module)
-    if prompt_len < 1:
-        raise ValueError("prompt_len must be >= 1")
-    if max_new_tokens < 1:
-        raise ValueError("max_new_tokens must be >= 1")
-    if prompt_len + max_new_tokens > module.max_len:
-        raise ValueError(
-            f"prompt_len ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
-            f"exceeds the model's max_len ({module.max_len})")
+    _validate_decode_args(module, prompt_len, max_new_tokens)
     if top_k is not None and top_k < 1:
         raise ValueError("top_k must be >= 1")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError("top_p must be in (0, 1]")
-    n_layers, n_heads = module.n_layers, module.n_heads
-    dh = module.d_model // n_heads
-    dtype = module.dtype
     greedy = temperature <= 0.0
 
     def sample(logits, key):
@@ -242,21 +265,10 @@ def make_generate_fn(module, prompt_len: int, max_new_tokens: int,
 
     @jax.jit
     def generate_fn(variables, prompts, key):
-        if prompts.shape[1] != prompt_len:
-            # static at trace time; a mismatched reuse of a compiled fn
-            # would otherwise decode against never-written cache slots
-            raise ValueError(
-                f"prompts have length {prompts.shape[1]} but this "
-                f"generate_fn was built for prompt_len={prompt_len}")
         params = variables["params"]
-        b = prompts.shape[0]
-        caches = [(jnp.zeros((b, module.max_len, n_heads, dh), dtype),
-                   jnp.zeros((b, module.max_len, n_heads, dh), dtype))
-                  for _ in range(n_layers)]
-        logits, caches = _forward_with_cache(
-            params, prompts, caches, 0, module)
+        last_logits, caches = _prefill(params, prompts, module, prompt_len)
         key, sub = jax.random.split(key)
-        tok = sample(logits[:, -1], sub)
+        tok = sample(last_logits, sub)
 
         def step(carry, step_key):
             tok, pos, caches = carry
@@ -291,6 +303,88 @@ def generate(module, variables, prompts, max_new_tokens: int,
     return np.asarray(fn(variables, prompts, key))
 
 
+def make_beam_search_fn(module, prompt_len: int, max_new_tokens: int,
+                        beam_width: int):
+    """A jitted `(variables, prompts (B, P) int32) -> (tokens, scores)`
+    beam-search program: tokens (B, W, P+N) ordered best-first per row,
+    scores (B, W) the summed token log-probabilities of each beam's
+    generated region.
+
+    Deterministic length-N beams (token-id models here carry no reserved
+    EOS, so no early stopping and no length penalty — all candidates have
+    equal length and rank directly by total log-probability).  Mechanics:
+    the prompt prefills ONCE per row, caches are then expanded to B*W
+    rows, and each scan step scores all beams' vocab expansions, keeps
+    the top W of W*V per row, and RE-INDEXES both the cache rows and the
+    token history to the surviving beams' ancestors — static shapes
+    throughout, so the whole search is one compiled program."""
+    _validate_decode_args(module, prompt_len, max_new_tokens)
+    if beam_width < 1:
+        raise ValueError("beam_width must be >= 1")
+    if beam_width > module.vocab_size:
+        raise ValueError(
+            f"beam_width ({beam_width}) cannot exceed the vocabulary "
+            f"({module.vocab_size}): the first expansion keeps beam_width "
+            "distinct tokens")
+    w = beam_width
+
+    @jax.jit
+    def beam_fn(variables, prompts):
+        params = variables["params"]
+        b = prompts.shape[0]
+        v = module.vocab_size
+        last_logits, caches = _prefill(params, prompts, module, prompt_len)
+        logprobs = jax.nn.log_softmax(last_logits, axis=-1)     # (B, V)
+        scores, tok = lax.top_k(logprobs, w)                    # (B, W)
+        tok = tok.astype(jnp.int32)
+        # every beam of a row shares the prompt's cache: expand B -> B*W
+        caches = [(jnp.repeat(kc, w, axis=0), jnp.repeat(vc, w, axis=0))
+                  for kc, vc in caches]
+        history = jnp.zeros((b, w, max_new_tokens), jnp.int32)
+        history = history.at[:, :, 0].set(tok)
+        row_base = jnp.arange(b)[:, None] * w                   # (B, 1)
+
+        def step(carry, t):
+            tok, scores, history, caches = carry
+            logits, caches = _forward_with_cache(
+                params, tok.reshape(b * w, 1), caches,
+                prompt_len + t, module)
+            logprobs = jax.nn.log_softmax(
+                logits[:, 0], axis=-1).reshape(b, w, v)
+            total = scores[:, :, None] + logprobs               # (B, W, V)
+            scores, flat_idx = lax.top_k(total.reshape(b, w * v), w)
+            beam_idx = flat_idx // v                            # ancestor
+            tok = (flat_idx % v).astype(jnp.int32)
+            take = (row_base + beam_idx).reshape(-1)            # (B*W,)
+            caches = [(kc[take], vc[take]) for kc, vc in caches]
+            history = jnp.take_along_axis(
+                history, beam_idx[:, :, None], axis=1)
+            history = history.at[:, :, t + 1].set(tok)
+            return (tok, scores, history, caches), None
+
+        if max_new_tokens > 1:
+            (tok, scores, history, caches), _ = lax.scan(
+                step, (tok, scores, history, caches),
+                jnp.arange(max_new_tokens - 1))
+        tokens = jnp.concatenate(
+            [jnp.broadcast_to(prompts[:, None], (b, w, prompt_len)),
+             history], axis=2)
+        return tokens, scores
+
+    return beam_fn
+
+
+def beam_search(module, variables, prompts, max_new_tokens: int,
+                beam_width: int = 4):
+    """One-shot convenience wrapper around `make_beam_search_fn`.
+    Returns (tokens (B, W, P+N) best-first, scores (B, W))."""
+    prompts = jnp.asarray(prompts, jnp.int32)
+    fn = make_beam_search_fn(module, prompts.shape[1], max_new_tokens,
+                             beam_width)
+    tokens, scores = fn(variables, prompts)
+    return np.asarray(tokens), np.asarray(scores)
+
+
 class TextGenerator(Transformer):
     """Pipeline Transformer: a token-prompt column in, a generated-token
     column out — the LM counterpart of TPUModel's scoring loop.
@@ -320,6 +414,10 @@ class TextGenerator(Transformer):
     topP = Param(1.0, "nucleus sampling: smallest probability mass to "
                  "sample within (1.0 = off; ignored when greedy)",
                  ptype=float, validator=lambda v: 0 < v <= 1)
+    beamWidth = Param(0, "deterministic beam search width; each row "
+                      "emits its best beam (0 = off; overrides "
+                      "temperature/topK/topP)", ptype=int,
+                      validator=lambda v: v >= 0)
     seed = Param(0, "sampling seed (ignored when greedy)", ptype=int)
 
     def __init__(self, bundle: Optional["ModelBundle"] = None, **kwargs):
@@ -354,6 +452,17 @@ class TextGenerator(Transformer):
         return self._bundle
 
     def _fn_for(self, prompt_len: int):
+        if self.beamWidth > 0:
+            key = ("beam", prompt_len, self.maxNewTokens, self.beamWidth)
+            if key not in self._compiled:
+                beam_fn = make_beam_search_fn(
+                    self._bundle.module(), prompt_len, self.maxNewTokens,
+                    self.beamWidth)
+                # uniform (variables, prompts, key) signature; the stage
+                # emits each row's BEST beam
+                self._compiled[key] = (
+                    lambda v, p, _k, fn=beam_fn: fn(v, p)[0][:, 0])
+            return self._compiled[key]
         # greedy ignores the filters: normalize them out of the cache key
         # so flipping topK/topP at temperature 0 never recompiles
         sampling = self.temperature > 0

@@ -323,6 +323,7 @@ def test_beam_width_one_equals_greedy(lm_bundle):
     np.testing.assert_array_equal(beams[:, 0], ref)
 
 
+@pytest.mark.slow
 def test_beam_scores_match_recomputed_logprobs(lm_bundle):
     """Every returned beam's score must equal the sum of its generated
     tokens' log-probabilities under a recompute-everything forward — the

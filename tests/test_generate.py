@@ -148,8 +148,11 @@ def test_moe_greedy_decode_matches_naive():
                                             n_experts=2, moe_group_size=1))
     toks = np.asarray([[3, 1, 4, 1]], np.int32)
     variables = moe.init(jax.random.key(2), jnp.asarray(toks))
-    got = generate(moe, variables, toks, max_new_tokens=8)
-    ref = naive_generate(moe, variables, toks, 8)
+    # 4 steps: every naive-oracle step is its own XLA compile, and the
+    # routing-equivalence property is per-token — longer horizons only
+    # re-prove it at higher compile cost
+    got = generate(moe, variables, toks, max_new_tokens=4)
+    ref = naive_generate(moe, variables, toks, 4)
     np.testing.assert_array_equal(got, ref)
 
 

@@ -18,6 +18,8 @@ Layer map (mirrors SURVEY.md section 1 of the reference analysis):
   ml/        - featurization, auto-ML train stages, evaluation
   stages/    - utility pipeline stages
   io/        - readers (image/binary/csv) and writers
+  resilience/- retry/breaker policies, chaos injection, checkpoint
+               rotation, preemption handling (docs/resilience.md)
   zoo/       - pretrained model repository client
   native/    - C++ host-side runtime pieces (decode, parse, hash)
 """

@@ -122,6 +122,13 @@ PROCESS_ID = register(
     "MMLSPARK_TPU_PROCESS_ID", default=None, ptype=_intp,
     doc="This process's index in the multi-host run (0 = coordinator).")
 
+COLLECTIVE_TIMEOUT_S = register(
+    "MMLSPARK_TPU_COLLECTIVE_TIMEOUT_S", default=600.0, ptype=_floatp,
+    doc="Bounded wait for named multi-host collectives (barriers, "
+        "checkpoint broadcast/gather): on expiry a CollectiveTimeoutError "
+        "names the operation instead of the job hanging forever "
+        "(parallel/distributed.py).")
+
 TEST_PLATFORM = register(
     "MMLSPARK_TPU_TEST_PLATFORM", default="cpu",
     doc="Test harness: 'cpu' forces the 8-virtual-device CPU mesh; 'tpu' "

@@ -23,10 +23,29 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from mmlspark_tpu.core.params import Params
+from mmlspark_tpu.core.params import Param, Params
 from mmlspark_tpu.core.table import DataTable
 
 _uid_counters = itertools.count()
+
+# Per-row error policy for row-wise transforms (the reference's graceful-
+# degradation convention: one bad row must be able to NOT abort a batch):
+#   "fail"    raise on the first bad row (the default — silent data loss
+#             is never opt-out);
+#   "skip"    drop bad rows from the output;
+#   "column"  keep every row; bad rows get a placeholder value and the
+#             error message lands in an `<output>_error` object column
+#             (None for healthy rows) so downstream stages can route or
+#             audit failures.
+ON_ERROR_POLICIES = ("fail", "skip", "column")
+
+
+def check_on_error(policy: str) -> str:
+    """Validate an on_error policy value (shared by stages and readers)."""
+    if policy not in ON_ERROR_POLICIES:
+        raise ValueError(
+            f"on_error must be one of {ON_ERROR_POLICIES}, got {policy!r}")
+    return policy
 
 
 def _fresh_uid(cls_name: str) -> str:
@@ -133,6 +152,12 @@ def _param_from_json(v):
 
 class Transformer(PipelineStage):
     """A stateless table -> table mapping."""
+
+    on_error = Param(
+        "fail", "per-row error policy for row-wise transforms: 'fail' "
+        "raises on the first bad row, 'skip' drops it, 'column' keeps the "
+        "row and records the message in an '<output>_error' column",
+        ptype=str, domain=ON_ERROR_POLICIES)
 
     def transform(self, table: DataTable) -> DataTable:
         raise NotImplementedError

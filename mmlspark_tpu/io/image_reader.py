@@ -17,10 +17,19 @@ import numpy as np
 
 from typing import Iterator
 
+from mmlspark_tpu.core.pipeline import check_on_error
 from mmlspark_tpu.core.schema import ColumnMeta, ImageSchema
 from mmlspark_tpu.core.table import DataTable, object_column
 from mmlspark_tpu.io.files import iter_binary_files, read_binary_files
 from mmlspark_tpu.native_loader import native_decode, native_decode_batch
+
+
+def _resolve_on_error(on_error: Optional[str], drop_failures: bool) -> str:
+    """Back-compat shim: the legacy drop_failures flag maps onto the
+    shared on_error policy ('skip'/'fail'); an explicit on_error wins."""
+    if on_error is not None:
+        return check_on_error(on_error)
+    return "skip" if drop_failures else "fail"
 
 
 def _pil_decode(data: bytes) -> Optional[np.ndarray]:
@@ -91,7 +100,7 @@ def decode_many(buffers: list) -> list:
 def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
                 inspect_zip: bool = True, resize_to: Optional[tuple] = None,
                 drop_failures: bool = True, pattern: Optional[str] = None,
-                seed: int = 0) -> DataTable:
+                seed: int = 0, on_error: Optional[str] = None) -> DataTable:
     """Read a directory/glob/zip of images into a table.
 
     Columns: `path`, `image`.  With resize_to=(H, W) `image` is a dense
@@ -99,23 +108,44 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
     (the deterministic contract shared with `read_images_iter`).  Without
     resize_to, uniform-shape corpora produce a dense (N, H, W, C) tensor
     with ImageSchema metadata and mixed shapes fall back to an object
-    column of per-image arrays.  Failed decodes are dropped when
-    drop_failures (the reference's per-row None filtering,
-    ImageReader.scala:55-59) or raise otherwise.
+    column of per-image arrays.
+
+    Failed decodes follow the `on_error` policy (core/pipeline.py):
+    "skip" drops the row (the reference's per-row None filtering,
+    ImageReader.scala:55-59), "fail" raises, "column" keeps every row —
+    the bad row's image is an all-zero placeholder and the message lands
+    in a `decode_error` object column (None for healthy rows), so one
+    undecodable image no longer aborts or silently shrinks a batch.
+    Default: the legacy `drop_failures` flag (True -> "skip",
+    False -> "fail").
     """
+    policy = _resolve_on_error(on_error, drop_failures)
     files = read_binary_files(path, recursive=recursive,
                               sample_ratio=sample_ratio,
                               inspect_zip=inspect_zip, pattern=pattern,
                               seed=seed)
-    paths, images = [], []
+    paths, images, errors = [], [], []
     decoded = decode_many(list(files["bytes"]))
     for p, img in zip(files["path"], decoded):
         if img is None:
-            if drop_failures:
+            if policy == "skip":
                 continue
-            raise ValueError(f"could not decode image: {p}")
+            if policy == "fail":
+                raise ValueError(f"could not decode image: {p}")
+            images.append(None)  # placeholder filled once a shape is known
+            paths.append(p)
+            errors.append(f"could not decode image: {p}")
+            continue
         images.append(img)
         paths.append(p)
+        errors.append(None)
+
+    if policy == "column":
+        shapes = [img.shape for img in images if img is not None]
+        fill_shape = ((resize_to + (3,)) if resize_to is not None
+                      else (shapes[0] if shapes else (1, 1, 3)))
+        images = [np.zeros(fill_shape, np.uint8) if img is None else img
+                  for img in images]
 
     if resize_to is not None and images:
         images = _resize_all(images, resize_to)
@@ -127,16 +157,22 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
             height=arr.shape[1], width=arr.shape[2], channels=arr.shape[3]))
         table = DataTable({"path": object_column(paths), "image": arr})
         table.set_meta("image", meta)
-        return table
-    return DataTable({"path": object_column(paths),
-                      "image": object_column(images)})
+    else:
+        table = DataTable({"path": object_column(paths),
+                           "image": object_column(images)})
+    if policy == "column":
+        table = table.with_column("decode_error", object_column(errors))
+    return table
 
 
-def _dense_batch(paths: list, images: list) -> DataTable:
+def _dense_batch(paths: list, images: list,
+                 errors: Optional[list] = None) -> DataTable:
     arr = np.stack(images)
     table = DataTable({"path": object_column(paths), "image": arr})
     table.set_meta("image", ColumnMeta(image=ImageSchema(
         height=arr.shape[1], width=arr.shape[2], channels=arr.shape[3])))
+    if errors is not None:
+        table = table.with_column("decode_error", object_column(errors))
     return table
 
 
@@ -146,7 +182,8 @@ def read_images_iter(path: str, batch_size: int = 256,
                      resize_to: Optional[tuple] = None,
                      drop_failures: bool = True,
                      pattern: Optional[str] = None,
-                     seed: int = 0) -> Iterator[DataTable]:
+                     seed: int = 0,
+                     on_error: Optional[str] = None) -> Iterator[DataTable]:
     """Stream a directory/glob/zip of images as dense fixed-shape batches.
 
     The out-of-core face of `read_images` (reference streams partitions,
@@ -162,11 +199,18 @@ def read_images_iter(path: str, batch_size: int = 256,
     all images must share one shape (a shape mismatch raises; streaming
     cannot re-group shapes after the fact the way the materializing reader
     does).
+
+    Failed decodes follow `on_error` exactly like `read_images` — with
+    the one streaming caveat that "column" without resize_to needs a
+    decodable image (or resize_to) before the first failure, since the
+    placeholder must match the stream's fixed shape.
     """
+    policy = _resolve_on_error(on_error, drop_failures)
     if batch_size <= 0:
         raise ValueError(f"batch_size must be positive, got {batch_size}")
     paths: list = []
     images: list = []
+    errors: list = []
     pend_paths: list = []
     pend_bufs: list = []
     first_shape: Optional[tuple] = None
@@ -176,9 +220,22 @@ def read_images_iter(path: str, batch_size: int = 256,
         decoded = decode_many(pend_bufs)
         for p, img in zip(pend_paths, decoded):
             if img is None:
-                if drop_failures:
+                if policy == "skip":
                     continue
-                raise ValueError(f"could not decode image: {p}")
+                if policy == "fail":
+                    raise ValueError(f"could not decode image: {p}")
+                if resize_to is not None:
+                    img = np.zeros(resize_to + (3,), np.uint8)
+                elif first_shape is not None:
+                    img = np.zeros(first_shape, np.uint8)
+                else:
+                    raise ValueError(
+                        f"on_error='column' placeholder for {p} needs a "
+                        "known shape: pass resize_to or ensure the stream "
+                        "starts with a decodable image")
+                errors.append(f"could not decode image: {p}")
+            else:
+                errors.append(None)
             if resize_to is None:
                 if first_shape is None:
                     first_shape = img.shape
@@ -193,13 +250,15 @@ def read_images_iter(path: str, batch_size: int = 256,
         pend_bufs.clear()
 
     def flush(k: int) -> DataTable:
-        nonlocal paths, images
+        nonlocal paths, images, errors
         batch, keep = images[:k], images[k:]
         batch_paths, paths = paths[:k], paths[k:]
+        batch_errors, errors = errors[:k], errors[k:]
         images = keep
         return _dense_batch(
             batch_paths, _resize_all(batch, resize_to)
-            if resize_to is not None else batch)
+            if resize_to is not None else batch,
+            batch_errors if policy == "column" else None)
 
     for p, data in iter_binary_files(path, recursive=recursive,
                                      sample_ratio=sample_ratio,

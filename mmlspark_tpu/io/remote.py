@@ -23,9 +23,13 @@ Listing protocols:
 
 Downloads go through one chunked reader (1 MiB ranges of progress, read
 timeouts), so a dead link fails fast instead of hanging a scoring
-pipeline.  The GCS/S3 endpoints are config variables, which is also how
-the tests drive these code paths against a local HTTP fixture without
-network egress.
+pipeline.  Every fetch runs under the resilience layer (`fetch_url`):
+exponential-backoff retries with `Retry-After` honored on 429/503,
+fail-fast classification for other 4xx (an auth error should not burn a
+backoff budget), and a per-host circuit breaker so a dead endpoint is
+refused in milliseconds instead of re-timed-out per object.  The GCS/S3
+endpoints are config variables, which is also how the tests drive these
+code paths against a local HTTP fixture without network egress.
 """
 
 from __future__ import annotations
@@ -35,7 +39,6 @@ import io
 import json
 import posixpath
 import urllib.parse
-import urllib.request
 import xml.etree.ElementTree as ET
 import zipfile
 from typing import Iterator, Optional
@@ -43,6 +46,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 from mmlspark_tpu import config
+from mmlspark_tpu.resilience.net import fetch_url
 
 _GCS_ENDPOINT = config.register(
     "MMLSPARK_TPU_GCS_ENDPOINT", "https://storage.googleapis.com",
@@ -56,7 +60,6 @@ _S3_ENDPOINT = config.register(
 _TIMEOUT = config.register(
     "MMLSPARK_TPU_REMOTE_TIMEOUT_S", 30.0,
     "per-request connect/read timeout for remote sources", ptype=float)
-_CHUNK = 1 << 20  # 1 MiB read granularity
 
 
 def is_remote(path: str) -> bool:
@@ -65,18 +68,12 @@ def is_remote(path: str) -> bool:
 
 
 def _fetch(url: str, headers: Optional[dict] = None) -> bytes:
-    """Chunked download: bounded reads with a per-request timeout so a
-    stalled link raises instead of wedging the ingestion loop."""
-    req = urllib.request.Request(url, headers=headers or {})
-    buf = io.BytesIO()
-    with urllib.request.urlopen(req, timeout=config.get(
-            "MMLSPARK_TPU_REMOTE_TIMEOUT_S")) as r:
-        while True:
-            chunk = r.read(_CHUNK)
-            if not chunk:
-                break
-            buf.write(chunk)
-    return buf.getvalue()
+    """Download under the resilience policy layer: chunked bounded reads
+    with a per-request timeout (a stalled link raises instead of wedging
+    the ingestion loop), retry/backoff for transient failures, and the
+    per-host circuit breaker (resilience/net.py)."""
+    return fetch_url(url, headers=headers,
+                     timeout=config.get("MMLSPARK_TPU_REMOTE_TIMEOUT_S"))
 
 
 def _gcs_headers() -> dict:

@@ -3,10 +3,14 @@ device profiling (reference Logging.scala:14-23 + Metrics.scala:37-47 +
 TestBase.scala:138-153; the profiler is TPU-native headroom)."""
 
 from mmlspark_tpu.observe.logging import LOG_ROOT, get_logger
-from mmlspark_tpu.observe.metrics import MetricData
+from mmlspark_tpu.observe.metrics import (MetricData, counters_metric_data,
+                                          counters_snapshot, get_counter,
+                                          inc_counter, reset_counters)
 from mmlspark_tpu.observe.profiler import annotate, profile
 from mmlspark_tpu.observe.timing import (StageTimings, instrument_stage_method,
                                          stage_timing)
 
 __all__ = ["LOG_ROOT", "get_logger", "MetricData", "annotate", "profile",
-           "StageTimings", "instrument_stage_method", "stage_timing"]
+           "StageTimings", "instrument_stage_method", "stage_timing",
+           "inc_counter", "get_counter", "counters_snapshot",
+           "reset_counters", "counters_metric_data"]

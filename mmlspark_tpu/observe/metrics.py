@@ -12,9 +12,51 @@ the Trainer emit them, `log()` routes them through the logger factory, and
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Mapping, Sequence
 
 from mmlspark_tpu.observe.logging import get_logger
+
+# --------------------------------------------------------------------------
+# Framework counters: monotonically increasing process-wide tallies that
+# subsystems (retry/breaker/chaos, checkpoint rotation) bump on events.
+# Deliberately tiny — a dict under a lock — so the resilience hot paths can
+# afford to increment on every attempt; `counters_metric_data()` folds the
+# current tallies into the same MetricData contract everything else speaks.
+# --------------------------------------------------------------------------
+
+_counters: dict[str, float] = {}
+_counters_lock = threading.Lock()
+
+
+def inc_counter(name: str, value: float = 1.0) -> None:
+    """Add `value` to the named process-wide counter (creates at 0)."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0.0) + value
+
+
+def get_counter(name: str) -> float:
+    """Current value of one counter (0.0 if never incremented)."""
+    with _counters_lock:
+        return _counters.get(name, 0.0)
+
+
+def counters_snapshot() -> dict[str, float]:
+    """A point-in-time copy of every counter."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Zero all counters (test isolation)."""
+    with _counters_lock:
+        _counters.clear()
+
+
+def counters_metric_data() -> "MetricData":
+    """The counter table as a MetricData row (metric_type='counters')."""
+    snap = counters_snapshot()
+    return MetricData.create(snap, "counters", "framework")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -12,9 +12,11 @@ xmanager, bash over ssh) that starts N identical processes works.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+import threading
+from typing import Any, Callable, Optional
 
 import jax
+import numpy as np
 
 
 @dataclasses.dataclass
@@ -65,6 +67,100 @@ def initialize_distributed(config: Optional[DistributedConfig] = None) -> bool:
     )
     _initialized = True
     return True
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A named collective did not complete within its deadline — some
+    participant is dead or wedged.  Carries enough to act on: which
+    operation, this process's id, and the full expected-peer set."""
+
+    def __init__(self, op: str, timeout_s: float, present: list[int]):
+        everyone = list(range(jax.process_count()))
+        missing = sorted(set(everyone) - set(present)) if present else None
+        detail = (f"; peers that reached the {op!r} rendezvous: {present}, "
+                  f"MISSING: {missing}" if present else
+                  f"; expected participants: {everyone}")
+        super().__init__(
+            f"collective {op!r} timed out after {timeout_s:.0f}s on "
+            f"process {jax.process_index()}{detail}. A participant host "
+            "is likely dead or wedged — check its logs, then restart the "
+            "job (training resumes from the newest checkpoint with "
+            "resume=True).")
+        self.op = op
+        self.timeout_s = timeout_s
+        self.present = present
+        self.missing = missing
+
+
+def collective_timeout_s() -> float:
+    from mmlspark_tpu import config
+    return float(config.COLLECTIVE_TIMEOUT_S.current())
+
+
+def run_collective(op: str, fn: Callable[[], Any],
+                   timeout_s: Optional[float] = None) -> Any:
+    """Run a blocking collective with a bounded wait.
+
+    Single-process: calls `fn` directly (nothing to hang on).  Multi-host:
+    `fn` runs in a worker thread and the caller waits at most `timeout_s`
+    (default MMLSPARK_TPU_COLLECTIVE_TIMEOUT_S); on expiry a
+    `CollectiveTimeoutError` NAMES the operation instead of the job
+    wedging forever inside an opaque XLA/DCN wait.  The abandoned worker
+    thread is daemonic — the process is expected to exit on this error.
+    """
+    if jax.process_count() == 1:
+        return fn()
+    timeout = timeout_s if timeout_s is not None else collective_timeout_s()
+    result: dict[str, Any] = {}
+    error: list[BaseException] = []
+
+    def run():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # surfaced to the caller below
+            error.append(e)
+
+    worker = threading.Thread(target=run, daemon=True,
+                              name=f"collective-{op}")
+    worker.start()
+    worker.join(timeout)
+    if worker.is_alive():
+        from mmlspark_tpu.observe.metrics import inc_counter
+        inc_counter("collective.timeouts")
+        raise CollectiveTimeoutError(op, timeout, present=[])
+    if error:
+        raise error[0]
+    return result["value"]
+
+
+def barrier(tag: str, timeout_s: Optional[float] = None) -> None:
+    """A named, bounded-wait barrier over all processes.
+
+    Place one before a broadcast/gather whose peers might be dead: the
+    barrier converts an indefinite hang into a CollectiveTimeoutError
+    that names the rendezvous point."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    run_collective(f"barrier:{tag}",
+                   lambda: multihost_utils.sync_global_devices(tag),
+                   timeout_s)
+
+
+def health_check(timeout_s: Optional[float] = None) -> list[int]:
+    """Allgather every process id with a bounded wait; returns the sorted
+    participant list (trivially [0] single-process).  A dead peer turns
+    into a CollectiveTimeoutError instead of an infinite stall."""
+    if jax.process_count() == 1:
+        return [0]
+    from jax.experimental import multihost_utils
+
+    def gather():
+        ids = multihost_utils.process_allgather(
+            np.asarray(jax.process_index()))
+        return sorted(int(i) for i in np.asarray(ids).ravel())
+
+    return run_collective("health_check", gather, timeout_s)
 
 
 def process_count() -> int:

@@ -1,0 +1,46 @@
+"""Resilience subsystem: retry/backoff, circuit breaking, chaos injection,
+checkpoint rotation, and preemption handling.
+
+The reference design outsourced all of this to Spark — task retry,
+lineage-based recompute, straggler re-execution (arXiv:1804.04031).  A
+TPU-native pipeline has no scheduler above it, so the policies live here
+as first-class, individually testable pieces:
+
+  clock.py        injectable clock (VirtualClock makes every test sleepless)
+  retry.py        exponential backoff + full jitter, classification, budgets
+  breaker.py      per-endpoint circuit breaker (closed/open/half-open)
+  chaos.py        deterministic seeded fault injector (MMLSPARK_TPU_CHAOS_*)
+  net.py          the single urlopen seam (lint-enforced) + fetch_url
+  checkpoints.py  keep-last-K rotation, LATEST pointer, checksum validation
+  preemption.py   SIGTERM -> finish step -> emergency checkpoint -> Preempted
+
+See docs/resilience.md for the operator-facing knobs.
+"""
+
+from mmlspark_tpu.resilience.breaker import (CircuitBreaker, CircuitOpenError,
+                                             get_breaker, reset_breakers)
+from mmlspark_tpu.resilience.chaos import (ChaosInjector, InjectedNetworkError,
+                                           InjectedStallError, get_injector,
+                                           reset_chaos)
+from mmlspark_tpu.resilience.checkpoints import (latest_valid_checkpoint,
+                                                 list_checkpoints,
+                                                 write_checkpoint)
+from mmlspark_tpu.resilience.clock import (Clock, VirtualClock, get_clock,
+                                           set_clock)
+from mmlspark_tpu.resilience.net import fetch_url, http_get
+from mmlspark_tpu.resilience.preemption import Preempted, PreemptionGuard
+from mmlspark_tpu.resilience.retry import (RetryBudgetExceeded, RetryPolicy,
+                                           default_classify, retry_call,
+                                           retryable_status)
+
+__all__ = [
+    "CircuitBreaker", "CircuitOpenError", "get_breaker", "reset_breakers",
+    "ChaosInjector", "InjectedNetworkError", "InjectedStallError",
+    "get_injector", "reset_chaos",
+    "latest_valid_checkpoint", "list_checkpoints", "write_checkpoint",
+    "Clock", "VirtualClock", "get_clock", "set_clock",
+    "fetch_url", "http_get",
+    "Preempted", "PreemptionGuard",
+    "RetryBudgetExceeded", "RetryPolicy", "default_classify", "retry_call",
+    "retryable_status",
+]

@@ -1,0 +1,155 @@
+"""Per-endpoint circuit breaker: closed -> open -> half-open probe.
+
+Retry policies protect a single call; the breaker protects the FLEET.
+When an endpoint (an object-store host, a model-repo CDN) has failed N
+consecutive times, every further call is refused instantly
+(`CircuitOpenError`) instead of each caller independently burning a full
+backoff budget against a dead host — the difference between an ingestion
+job that fails in milliseconds with a clear diagnosis and one that takes
+minutes to die.  After `reset_s` of cooldown one PROBE call is let
+through (half-open): success closes the circuit, failure re-opens it and
+restarts the cooldown.
+
+State transitions and refusals are counted through `observe.metrics`
+(`breaker.<event>`); cooldowns read `resilience.clock`, so breaker tests
+run on a VirtualClock.  Instances are thread-safe; `get_breaker(endpoint)`
+returns the process-wide breaker for an endpoint key (one per host).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from mmlspark_tpu import config
+from mmlspark_tpu.observe.logging import get_logger
+from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.resilience.clock import Clock, get_clock
+
+BREAKER_THRESHOLD = config.register(
+    "MMLSPARK_TPU_BREAKER_THRESHOLD", 5,
+    "circuit breaker: consecutive failures that open the circuit "
+    "(0 disables breaking entirely)", ptype=int)
+BREAKER_RESET_S = config.register(
+    "MMLSPARK_TPU_BREAKER_RESET_S", 30.0,
+    "circuit breaker: cooldown before the half-open probe", ptype=float)
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitOpenError(ConnectionError):
+    """Refused without calling: the endpoint's circuit is open."""
+
+    def __init__(self, endpoint: str, retry_in_s: float):
+        super().__init__(
+            f"circuit open for endpoint {endpoint!r}; "
+            f"probe allowed in {max(0.0, retry_in_s):.1f}s")
+        self.endpoint = endpoint
+        self.retry_in_s = retry_in_s
+
+
+class CircuitBreaker:
+    """One endpoint's failure gate.  Use `allow()` before the call and
+    `record_success()` / `record_failure()` after — or let
+    `RetryPolicy.call(..., breaker=...)` drive all three."""
+
+    def __init__(self, endpoint: str, threshold: Optional[int] = None,
+                 reset_s: Optional[float] = None,
+                 clock: Optional[Clock] = None):
+        self.endpoint = endpoint
+        self._threshold = threshold
+        self._reset_s = reset_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self._opened_at = 0.0
+
+    # config is re-read per call so tests (and live operators) can tune
+    # knobs without rebuilding the breaker registry
+    @property
+    def threshold(self) -> int:
+        return self._threshold if self._threshold is not None \
+            else int(BREAKER_THRESHOLD.current())
+
+    @property
+    def reset_s(self) -> float:
+        return self._reset_s if self._reset_s is not None \
+            else float(BREAKER_RESET_S.current())
+
+    def _now(self) -> float:
+        return (self._clock or get_clock()).monotonic()
+
+    def allow(self) -> None:
+        """Gate one attempt: no-op when closed, raises when open, lets a
+        single probe through once the cooldown has elapsed."""
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            if self.state == CLOSED:
+                return
+            waited = self._now() - self._opened_at
+            if self.state == OPEN and waited >= self.reset_s:
+                self.state = HALF_OPEN
+                inc_counter("breaker.half_open")
+                get_logger("resilience").info(
+                    "breaker %s: half-open probe after %.1fs",
+                    self.endpoint, waited)
+                return  # this caller IS the probe
+            if self.state == HALF_OPEN:
+                # a probe is already in flight; refuse concurrent callers
+                # (they would defeat the single-probe semantics)
+                inc_counter("breaker.refused")
+                raise CircuitOpenError(self.endpoint, self.reset_s)
+            inc_counter("breaker.refused")
+            raise CircuitOpenError(self.endpoint,
+                                   self.reset_s - waited)
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state != CLOSED:
+                inc_counter("breaker.closed")
+                get_logger("resilience").info(
+                    "breaker %s: closed after successful probe",
+                    self.endpoint)
+            self.state = CLOSED
+            self.consecutive_failures = 0
+
+    def record_failure(self, exc: Optional[BaseException] = None) -> None:
+        if self.threshold <= 0:
+            return
+        if isinstance(exc, CircuitOpenError):
+            return  # a refusal is not new evidence against the endpoint
+        with self._lock:
+            self.consecutive_failures += 1
+            trip = (self.state == HALF_OPEN
+                    or self.consecutive_failures >= self.threshold)
+            if trip and self.state != OPEN:
+                self.state = OPEN
+                self._opened_at = self._now()
+                inc_counter("breaker.opened")
+                get_logger("resilience").warning(
+                    "breaker %s: OPEN after %d consecutive failures "
+                    "(last: %r); cooling down %.1fs", self.endpoint,
+                    self.consecutive_failures, exc, self.reset_s)
+            elif trip:
+                self._opened_at = self._now()  # failed probe: restart cooldown
+
+
+_breakers: dict[str, CircuitBreaker] = {}
+_registry_lock = threading.Lock()
+
+
+def get_breaker(endpoint: str) -> CircuitBreaker:
+    """The process-wide breaker for an endpoint key (e.g. a URL's host)."""
+    with _registry_lock:
+        breaker = _breakers.get(endpoint)
+        if breaker is None:
+            breaker = _breakers[endpoint] = CircuitBreaker(endpoint)
+        return breaker
+
+
+def reset_breakers() -> None:
+    """Drop all breaker state (test isolation)."""
+    with _registry_lock:
+        _breakers.clear()

@@ -1,0 +1,153 @@
+"""Deterministic fault injection: the test harness for the resilience layer.
+
+A resilience subsystem that is only ever exercised by real outages is
+untested code.  `ChaosInjector` is a seeded, config-driven fault source
+that the I/O and training layers consult at their hazard points:
+
+  * `on_request(url)`   — before a network fetch: may raise a connection
+    error or a (virtual-clock) stalled-read timeout;
+  * `on_step(step)`     — per training step: may deliver one simulated
+    SIGTERM preemption at a configured step;
+  * `tear_file(path)`   — truncates a file in place, simulating a torn
+    checkpoint from a crash or partial upload;
+  * `maybe_tear_checkpoint(path)` — probabilistic form of the same, hooked
+    into checkpoint rotation.
+
+Determinism: one `random.Random(seed)` drives every probabilistic
+decision, so a given seed + call sequence produces the SAME fault
+pattern on every run — chaos tests are exactly reproducible, never
+flaky-by-design.  Everything is off (zero rates, no seed needed) unless
+the MMLSPARK_TPU_CHAOS_* variables turn it on.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+from typing import Optional
+
+from mmlspark_tpu import config
+from mmlspark_tpu.observe.logging import get_logger
+from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.resilience.clock import get_clock
+
+CHAOS_SEED = config.register(
+    "MMLSPARK_TPU_CHAOS_SEED", 0,
+    "chaos injector: RNG seed (fault patterns are a pure function of "
+    "seed + call order)", ptype=int)
+CHAOS_NET_ERROR_RATE = config.register(
+    "MMLSPARK_TPU_CHAOS_NET_ERROR_RATE", 0.0,
+    "chaos injector: probability a network request raises a connection "
+    "error (0 = off)", ptype=float)
+CHAOS_STALL_RATE = config.register(
+    "MMLSPARK_TPU_CHAOS_STALL_RATE", 0.0,
+    "chaos injector: probability a network request stalls for "
+    "CHAOS_STALL_S then times out (0 = off)", ptype=float)
+CHAOS_STALL_S = config.register(
+    "MMLSPARK_TPU_CHAOS_STALL_S", 30.0,
+    "chaos injector: stalled-read duration (spent on the resilience "
+    "clock, so virtual under tests)", ptype=float)
+CHAOS_TORN_CKPT_RATE = config.register(
+    "MMLSPARK_TPU_CHAOS_TORN_CKPT_RATE", 0.0,
+    "chaos injector: probability a freshly written checkpoint is torn "
+    "(truncated) after the fact (0 = off)", ptype=float)
+CHAOS_PREEMPT_AT_STEP = config.register(
+    "MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", 0,
+    "chaos injector: deliver one simulated SIGTERM when training reaches "
+    "this global step (0 = off)", ptype=int)
+
+
+class InjectedNetworkError(ConnectionError):
+    """A chaos-injected connection failure (retryable by classification)."""
+
+
+class InjectedStallError(TimeoutError):
+    """A chaos-injected stalled read that hit its timeout."""
+
+
+class ChaosInjector:
+    """One seeded fault source; `get_injector()` holds the process instance."""
+
+    def __init__(self, seed: Optional[int] = None,
+                 net_error_rate: Optional[float] = None,
+                 stall_rate: Optional[float] = None,
+                 stall_s: Optional[float] = None,
+                 torn_ckpt_rate: Optional[float] = None,
+                 preempt_at_step: Optional[int] = None):
+        read = lambda explicit, var, cast: cast(
+            var.current() if explicit is None else explicit)
+        self.net_error_rate = read(net_error_rate, CHAOS_NET_ERROR_RATE, float)
+        self.stall_rate = read(stall_rate, CHAOS_STALL_RATE, float)
+        self.stall_s = read(stall_s, CHAOS_STALL_S, float)
+        self.torn_ckpt_rate = read(torn_ckpt_rate, CHAOS_TORN_CKPT_RATE, float)
+        self.preempt_at_step = read(preempt_at_step, CHAOS_PREEMPT_AT_STEP, int)
+        self._rng = random.Random(read(seed, CHAOS_SEED, int))
+        self._preempt_fired = False
+
+    @property
+    def active(self) -> bool:
+        return bool(self.net_error_rate or self.stall_rate
+                    or self.torn_ckpt_rate or self.preempt_at_step)
+
+    # -- network hazards -------------------------------------------------
+    def on_request(self, url: str) -> None:
+        """Called before a network fetch; may raise an injected fault."""
+        if self.net_error_rate and self._rng.random() < self.net_error_rate:
+            inc_counter("chaos.net_errors")
+            raise InjectedNetworkError(
+                f"chaos: injected connection error for {url}")
+        if self.stall_rate and self._rng.random() < self.stall_rate:
+            inc_counter("chaos.stalls")
+            get_clock().sleep(self.stall_s)  # virtual under tests
+            raise InjectedStallError(
+                f"chaos: injected {self.stall_s:.0f}s stalled read for {url}")
+
+    # -- checkpoint hazards ----------------------------------------------
+    @staticmethod
+    def tear_file(path: str, keep_fraction: float = 0.5) -> None:
+        """Truncate `path` in place — a torn write/partial upload."""
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, int(size * keep_fraction)))
+        inc_counter("chaos.torn_files")
+        get_logger("resilience").warning("chaos: tore file %s", path)
+
+    def maybe_tear_checkpoint(self, path: str) -> bool:
+        if self.torn_ckpt_rate and self._rng.random() < self.torn_ckpt_rate:
+            self.tear_file(path)
+            return True
+        return False
+
+    # -- preemption -------------------------------------------------------
+    def on_step(self, step: int) -> None:
+        """Deliver the configured one-shot SIGTERM when `step` arrives.
+
+        Uses a real signal (not a flag) so the SAME handler path that a
+        cloud preemption notice exercises is the one under test.
+        """
+        if (self.preempt_at_step and not self._preempt_fired
+                and step >= self.preempt_at_step):
+            self._preempt_fired = True
+            inc_counter("chaos.preemptions")
+            get_logger("resilience").warning(
+                "chaos: raising simulated SIGTERM at step %d", step)
+            signal.raise_signal(signal.SIGTERM)
+
+
+_injector: Optional[ChaosInjector] = None
+
+
+def get_injector() -> ChaosInjector:
+    """The process injector, built lazily from the CHAOS_* config."""
+    global _injector
+    if _injector is None:
+        _injector = ChaosInjector()
+    return _injector
+
+
+def reset_chaos() -> None:
+    """Rebuild the injector from current config on next use (tests call
+    this after flipping CHAOS_* variables)."""
+    global _injector
+    _injector = None

@@ -1,0 +1,160 @@
+"""Checkpoint rotation: keep-last-K, LATEST pointer, checksum validation.
+
+The trainer's old layout was a single `checkpoint.msgpack` overwritten in
+place — atomic per write, but one torn file (partial upload, disk-full,
+chaos) meant TOTAL loss of progress, and a preempted worker restarting
+against it would crash instead of falling back.  This module owns the
+directory layout the trainer now writes:
+
+    ckpt_dir/
+      ckpt_0000000100.msgpack          payload (atomic tmp+rename)
+      ckpt_0000000100.msgpack.sha256   sidecar checksum
+      ckpt_0000000200.msgpack
+      ckpt_0000000200.msgpack.sha256
+      LATEST                           name of the newest checkpoint
+
+Restore walks candidates newest-first (the LATEST pointer is an
+optimization, not trusted): a checkpoint only qualifies if its sidecar
+checksum matches the payload bytes, so a torn or bit-rotted file is
+SKIPPED with a warning and a counter — never crashed on.  A legacy
+`checkpoint.msgpack` (no sidecar) is accepted last for forward
+compatibility with pre-rotation directories.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+from mmlspark_tpu import config
+from mmlspark_tpu.observe.logging import get_logger
+from mmlspark_tpu.observe.metrics import inc_counter
+
+CKPT_KEEP = config.register(
+    "MMLSPARK_TPU_CKPT_KEEP", 3,
+    "checkpoint rotation: how many validated checkpoints to keep",
+    ptype=int)
+
+_PREFIX = "ckpt_"
+_SUFFIX = ".msgpack"
+_LEGACY = "checkpoint.msgpack"
+LATEST = "LATEST"
+
+
+def checkpoint_name(step: int) -> str:
+    return f"{_PREFIX}{step:010d}{_SUFFIX}"
+
+
+def step_of(name: str) -> int:
+    return int(name[len(_PREFIX):-len(_SUFFIX)])
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn file HERE
+
+
+def write_checkpoint(ckpt_dir: str, step: int, data: bytes,
+                     keep: Optional[int] = None) -> str:
+    """Write one checkpoint + checksum sidecar, advance LATEST, prune.
+
+    Returns the payload path.  The sidecar is written BEFORE the payload
+    rename lands and LATEST moves only after both, so every state a crash
+    can leave behind is either ignorable (orphan tmp/sidecar) or valid.
+    """
+    os.makedirs(ckpt_dir, exist_ok=True)
+    name = checkpoint_name(step)
+    path = os.path.join(ckpt_dir, name)
+    _atomic_write(path + ".sha256", _sha256(data).encode())
+    _atomic_write(path, data)
+    # chaos may tear the file we just wrote (simulating partial upload /
+    # crash-adjacent corruption); restore-side validation must absorb it
+    from mmlspark_tpu.resilience.chaos import get_injector
+    get_injector().maybe_tear_checkpoint(path)
+    _atomic_write(os.path.join(ckpt_dir, LATEST), name.encode())
+    inc_counter("checkpoint.writes")
+    prune(ckpt_dir, keep if keep is not None else int(CKPT_KEEP.current()))
+    return path
+
+
+def list_checkpoints(ckpt_dir: str) -> list[tuple[int, str]]:
+    """[(step, path)] of rotation-layout checkpoints, newest first."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        if name.startswith(_PREFIX) and name.endswith(_SUFFIX):
+            try:
+                out.append((step_of(name), os.path.join(ckpt_dir, name)))
+            except ValueError:
+                continue
+    return sorted(out, reverse=True)
+
+
+def is_valid(path: str) -> bool:
+    """True when the payload matches its sidecar checksum."""
+    sidecar = path + ".sha256"
+    if not (os.path.exists(path) and os.path.exists(sidecar)):
+        return False
+    with open(sidecar) as f:
+        expected = f.read().strip()
+    with open(path, "rb") as f:
+        return _sha256(f.read()) == expected
+
+
+def latest_valid_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Newest checkpoint that passes validation, or None.
+
+    Order: the LATEST pointer's target first (the common case), then all
+    rotation checkpoints newest-first, then the legacy single-file layout.
+    Invalid candidates are skipped with a warning, not raised on.
+    """
+    candidates: list[str] = []
+    pointer = os.path.join(ckpt_dir, LATEST)
+    if os.path.exists(pointer):
+        with open(pointer) as f:
+            candidates.append(os.path.join(ckpt_dir, f.read().strip()))
+    candidates += [p for _, p in list_checkpoints(ckpt_dir)]
+    seen = set()
+    log = get_logger("resilience")
+    for path in candidates:
+        if path in seen:
+            continue
+        seen.add(path)
+        if is_valid(path):
+            return path
+        if os.path.exists(path):
+            inc_counter("checkpoint.skipped_corrupt")
+            log.warning("skipping corrupt/torn checkpoint %s "
+                        "(checksum mismatch)", path)
+    legacy = os.path.join(ckpt_dir, _LEGACY)
+    if os.path.exists(legacy):
+        return legacy  # pre-rotation layout: no sidecar to validate
+    return None
+
+
+def prune(ckpt_dir: str, keep: int) -> None:
+    """Delete rotation checkpoints (and sidecars) beyond the newest `keep`.
+
+    Only VALID checkpoints count against the budget: corrupt files are
+    deleted outright rather than crowding out good ones."""
+    if keep <= 0:
+        return
+    kept = 0
+    for _, path in list_checkpoints(ckpt_dir):
+        if kept < keep and is_valid(path):
+            kept += 1
+            continue
+        for victim in (path, path + ".sha256"):
+            try:
+                os.remove(victim)
+            except FileNotFoundError:
+                pass
+        inc_counter("checkpoint.pruned")

@@ -39,8 +39,14 @@ from mmlspark_tpu.models.definitions import build_model
 from mmlspark_tpu.observe import MetricData, get_logger
 from mmlspark_tpu.parallel.bridge import (gather_replicated, gather_to_host,
                                           put_sharded)
-from mmlspark_tpu.parallel.distributed import initialize_distributed, is_coordinator
+from mmlspark_tpu.parallel.distributed import (barrier, initialize_distributed,
+                                               is_coordinator, run_collective)
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh, replicated
+from mmlspark_tpu.resilience.chaos import get_injector
+from mmlspark_tpu.resilience.checkpoints import (checkpoint_name,
+                                                 latest_valid_checkpoint,
+                                                 write_checkpoint)
+from mmlspark_tpu.resilience.preemption import Preempted, PreemptionGuard
 from mmlspark_tpu.train.config import TrainerConfig
 
 
@@ -240,8 +246,11 @@ class Trainer:
         params = jax.tree_util.tree_map(jax.device_put, params, shardings)
         batch_stats = jax.tree_util.tree_map(
             lambda leaf: jax.device_put(leaf, replicated(self.mesh)), batch_stats)
-        # opt_state leaves mirror params; jit propagates their shardings
-        opt_state = jax.jit(self._tx.init)(params)
+        # opt_state leaves mirror params; EAGER init follows each param
+        # leaf's NamedSharding (a jitted init commits the fresh zeros to
+        # one device instead, leaving a mixed-device state that a later
+        # checkpoint gather or post-restore step rejects)
+        opt_state = self._tx.init(params)
         # warm starts resume the global step (bundle_from_state stamps it)
         # so checkpoint_every_steps boundaries align across fit() calls
         start = int((initial_bundle.metadata or {}).get("steps", 0)) \
@@ -268,7 +277,9 @@ class Trainer:
                 max_len=m.max_len, mlp_ratio=m.mlp_ratio)
         params = jax.device_put(
             params, pipeline_param_shardings(self.mesh, params))
-        opt_state = jax.jit(self._tx.init)(params)
+        # eager init: opt_state shardings mirror the stage-sharded params
+        # (see init_state — jitted init would commit to one device)
+        opt_state = self._tx.init(params)
         start = int((initial_bundle.metadata or {}).get("steps", 0)) \
             if initial_bundle is not None else 0
         return TrainState(step=jnp.asarray(start, jnp.int32), params=params,
@@ -347,14 +358,27 @@ class Trainer:
     def fit_arrays(self, x: np.ndarray, y: np.ndarray,
                    initial_bundle: Optional[ModelBundle] = None,
                    log_every: int = 50,
-                   log_fn: Optional[Callable[[str], None]] = None) -> ModelBundle:
+                   log_fn: Optional[Callable[[str], None]] = None,
+                   ckpt_dir: Optional[str] = None,
+                   resume: bool = False) -> ModelBundle:
         """Train on arrays; under multi-host, `x`/`y` are this process's
         local data partition (the per-node data shard of the reference's
         MPI topology, CommandBuilders.scala:95-117) and each process
         contributes `batch_size / process_count` rows per global step via
         `put_sharded` — no host ever holds the global batch.
+
+        Preemption safety (docs/resilience.md): `ckpt_dir` (default:
+        config.checkpoint_dir) arms a SIGTERM guard — on preemption the
+        in-flight step finishes, an emergency checkpoint is written, and
+        `Preempted` is raised for the job runner to exit cleanly on.
+        `resume=True` restarts from the newest VALID checkpoint in
+        `ckpt_dir` (torn/corrupt files are skipped by checksum), replaying
+        the same data order and skipping already-completed steps, so a
+        preempted-and-resumed run finishes with the same step count as an
+        uninterrupted one.
         """
         cfg = self.config
+        ckpt_dir = ckpt_dir if ckpt_dir is not None else cfg.checkpoint_dir
         nproc = jax.process_count()
         n_local = len(x)
         n = n_local
@@ -382,8 +406,9 @@ class Trainer:
                     n, n_local)
             # save_checkpoint is a collective: every process must take the
             # checkpoint branches in lockstep or the job deadlocks
-            flags = np.asarray([int(bool(cfg.checkpoint_dir)),
-                                int(cfg.checkpoint_every_steps or 0)])
+            flags = np.asarray([int(bool(ckpt_dir)),
+                                int(cfg.checkpoint_every_steps or 0),
+                                int(bool(resume))])
             all_flags = multihost_utils.process_allgather(flags)
             if not (all_flags == flags).all():
                 raise ValueError(
@@ -406,59 +431,109 @@ class Trainer:
         state = self.init_state((1,) + x.shape[1:], total_steps,
                                 initial_bundle,
                                 input_dtype=np.asarray(x).dtype)
+        # the step numbering this run starts from (0, or the warm-start
+        # bundle's recorded step); a resume checkpoint advances past it
+        base_step = int(state.step)
+        skip_until = base_step
+        if resume and ckpt_dir:
+            # every process must agree whether a restore happens (it is a
+            # collective); the coordinator's directory decides
+            found = int(latest_valid_checkpoint(ckpt_dir) is not None) \
+                if is_coordinator() else 0
+            if nproc > 1:
+                from jax.experimental import multihost_utils
+                found = int(run_collective(
+                    "resume.poll", lambda: multihost_utils.
+                    broadcast_one_to_all(np.asarray(found, np.int32))))
+            if found:
+                state = self.restore_checkpoint(state, ckpt_dir)
+                skip_until = int(state.step)
+                get_logger("train").info(
+                    "resuming from checkpoint at step %d "
+                    "(skipping %d completed steps)", skip_until,
+                    skip_until - base_step)
         step_fn = self.make_train_step()
         x_sh = batch_sharding(self.mesh)
 
         # distinct per-process streams so partitions shuffle independently
         rng = np.random.default_rng(cfg.seed + jax.process_index())
         t0 = time.perf_counter()
-        # host-side counter seeded once from the (possibly resumed) global
-        # step so checkpoint_every_steps boundaries stay aligned across
-        # fit() calls; never sync on state.step mid-epoch
-        step = int(state.step)
+        # host-side counter seeded once from this run's base step so
+        # checkpoint_every_steps boundaries stay aligned across fit()
+        # calls; never sync on state.step mid-epoch.  On resume it replays
+        # the original numbering, skipping steps below `skip_until` —
+        # the epoch/batch order is identical, so the resumed run feeds
+        # exactly the batches the preempted one never saw.
+        step = base_step
+        chaos = get_injector()
         self._rows_seen = np.zeros(n_local, bool)  # coverage, inspectable
-        for epoch in range(cfg.epochs):
-            order = _epoch_order(rng, epoch, n, n_local,
-                                 cfg.shuffle_each_epoch)
-            self._rows_seen[order] = True
-            losses: list = []
-            step_metrics: list = []
-            for start in range(0, n, bs_local):
-                idx = order[start:start + bs_local]
-                valid = len(idx)
-                if valid < bs_local:
-                    # cycle real rows into the pad (see module docstring)
-                    idx = np.concatenate([idx, np.resize(order, bs_local - valid)])
-                mask = np.zeros(bs_local, np.float32)
-                mask[:valid] = 1.0
-                xb = put_sharded(x[idx], x_sh)
-                yb = put_sharded(y[idx], x_sh)
-                mask_d = put_sharded(mask, x_sh)
-                state, loss, metrics = step_fn(state, xb, yb, mask_d)
-                losses.append(loss)  # device array; fetched at epoch end
-                if metrics:
-                    step_metrics.append(metrics)
-                step += 1
-                if cfg.checkpoint_dir and cfg.checkpoint_every_steps and \
-                        step % cfg.checkpoint_every_steps == 0:
-                    self.save_checkpoint(state, cfg.checkpoint_dir)
-            n_batches = len(losses)
-            epoch_loss = float(np.sum(jax.device_get(losses)))
-            rec = {"epoch": epoch, "loss": epoch_loss / max(n_batches, 1),
-                   "wall_s": time.perf_counter() - t0}
-            if step_metrics:
-                # model-sown diagnostics (e.g. MoE overflow fraction)
-                # averaged over the epoch's steps, one history column each
-                fetched = jax.device_get(step_metrics)
-                for key in fetched[0]:
-                    rec[key] = float(np.mean([m[key] for m in fetched]))
-            self.history.append(rec)
-            emit = log_fn if log_fn is not None else get_logger("train").info
-            if epoch % max(1, log_every) == 0 or epoch == cfg.epochs - 1:
-                emit(f"epoch {epoch}: loss={rec['loss']:.5f} "
-                     f"({rec['wall_s']:.1f}s)")
-        if cfg.checkpoint_dir:
-            self.save_checkpoint(state, cfg.checkpoint_dir)
+        with PreemptionGuard(install=bool(ckpt_dir)) as guard:
+            for epoch in range(cfg.epochs):
+                order = _epoch_order(rng, epoch, n, n_local,
+                                     cfg.shuffle_each_epoch)
+                self._rows_seen[order] = True
+                losses: list = []
+                step_metrics: list = []
+                for start in range(0, n, bs_local):
+                    if step < skip_until:  # completed before preemption
+                        step += 1
+                        continue
+                    chaos.on_step(step)  # may deliver the simulated SIGTERM
+                    idx = order[start:start + bs_local]
+                    valid = len(idx)
+                    if valid < bs_local:
+                        # cycle real rows into the pad (see module docstring)
+                        idx = np.concatenate([idx,
+                                              np.resize(order,
+                                                        bs_local - valid)])
+                    mask = np.zeros(bs_local, np.float32)
+                    mask[:valid] = 1.0
+                    xb = put_sharded(x[idx], x_sh)
+                    yb = put_sharded(y[idx], x_sh)
+                    mask_d = put_sharded(mask, x_sh)
+                    state, loss, metrics = step_fn(state, xb, yb, mask_d)
+                    losses.append(loss)  # device array; fetched at epoch end
+                    if metrics:
+                        step_metrics.append(metrics)
+                    step += 1
+                    if ckpt_dir and cfg.checkpoint_every_steps and \
+                            step % cfg.checkpoint_every_steps == 0:
+                        self.save_checkpoint(state, ckpt_dir)
+                    # the in-flight step finished; honor a pending SIGTERM
+                    # at the step boundary (lockstep under multi-host:
+                    # every process must agree before the collective save)
+                    preempt_now = guard.triggered
+                    if nproc > 1:
+                        from jax.experimental import multihost_utils
+                        preempt_now = bool(run_collective(
+                            "preempt.sync", lambda: int(np.asarray(
+                                multihost_utils.process_allgather(
+                                    np.asarray(int(guard.triggered))))
+                                .max())))
+                    if preempt_now:
+                        self.save_checkpoint(state, ckpt_dir)
+                        self._last_state = state
+                        raise Preempted(step=step, ckpt_dir=ckpt_dir)
+                if not losses:
+                    continue  # epoch fully skipped by resume: no history row
+                n_batches = len(losses)
+                epoch_loss = float(np.sum(jax.device_get(losses)))
+                rec = {"epoch": epoch, "loss": epoch_loss / max(n_batches, 1),
+                       "wall_s": time.perf_counter() - t0}
+                if step_metrics:
+                    # model-sown diagnostics (e.g. MoE overflow fraction)
+                    # averaged over the epoch's steps, one history column each
+                    fetched = jax.device_get(step_metrics)
+                    for key in fetched[0]:
+                        rec[key] = float(np.mean([m[key] for m in fetched]))
+                self.history.append(rec)
+                emit = log_fn if log_fn is not None \
+                    else get_logger("train").info
+                if epoch % max(1, log_every) == 0 or epoch == cfg.epochs - 1:
+                    emit(f"epoch {epoch}: loss={rec['loss']:.5f} "
+                         f"({rec['wall_s']:.1f}s)")
+        if ckpt_dir:
+            self.save_checkpoint(state, ckpt_dir)
         # the run's loss curve through the typed contract (Metrics.scala:37-47)
         self.training_metric_data().log("train", "debug")
         self._last_state = state  # inspectable (sharding asserts, resume)
@@ -495,30 +570,33 @@ class Trainer:
 
     # -- checkpoint / resume (absent in the reference; first-class here) --
     def save_checkpoint(self, state: TrainState, ckpt_dir: str) -> str:
-        """Write an atomic checkpoint; a collective under multi-host (the
-        gather runs on every process) but only the coordinator writes, so
-        concurrent hosts sharing a filesystem never race."""
-        dev = gather_replicated(
-            {"step": state.step, "params": state.params,
-             "opt_state": state.opt_state, "batch_stats": state.batch_stats},
-            self.mesh)
-        path = os.path.join(ckpt_dir, "checkpoint.msgpack")
+        """Write one rotation checkpoint (keep-last-K + LATEST pointer +
+        sha256 sidecar, resilience/checkpoints.py); a collective under
+        multi-host (the gather runs on every process, bounded by the
+        collective timeout) but only the coordinator writes, so concurrent
+        hosts sharing a filesystem never race."""
+        dev = run_collective(
+            "checkpoint.gather", lambda: gather_replicated(
+                {"step": state.step, "params": state.params,
+                 "opt_state": state.opt_state,
+                 "batch_stats": state.batch_stats},
+                self.mesh))
+        step = int(state.step)
         if not is_coordinator():
-            return path  # the gather ran (collective); skip the D2H copy
+            # the gather ran (collective); skip the D2H copy and the write
+            return os.path.join(ckpt_dir, checkpoint_name(step))
         host = jax.device_get(dev)
-        os.makedirs(ckpt_dir, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(serialization.to_bytes(host))
-        os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
-        return path
+        return write_checkpoint(ckpt_dir, step,
+                                serialization.to_bytes(host))
 
     def restore_checkpoint(self, state: TrainState, ckpt_dir: str) -> TrainState:
-        """Restore from the coordinator's checkpoint.  Under multi-host only
-        the coordinator reads the file (matching coordinator-only writes —
-        no shared filesystem required); values reach the other hosts via a
-        broadcast collective."""
-        path = os.path.join(ckpt_dir, "checkpoint.msgpack")
+        """Restore from the newest VALID checkpoint in the coordinator's
+        `ckpt_dir` (checksum-validated; torn/corrupt files are skipped, a
+        legacy single-file layout is accepted).  Under multi-host only the
+        coordinator reads the file (matching coordinator-only writes — no
+        shared filesystem required); values reach the other hosts via a
+        broadcast collective, with a named barrier + bounded waits so a
+        dead peer raises a diagnostic instead of hanging the job."""
         # from_bytes needs only shapes/dtypes/structure — build the template
         # locally (no collectives, no D2H of live state)
         template = jax.tree_util.tree_map(
@@ -527,21 +605,35 @@ class Trainer:
              "opt_state": state.opt_state, "batch_stats": state.batch_stats})
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
+            # all peers must be alive before committing to the broadcast:
+            # the barrier converts a dead host into a CollectiveTimeoutError
+            # naming this rendezvous, not an indefinite wedge
+            barrier("restore_checkpoint")
+            path = latest_valid_checkpoint(ckpt_dir) if is_coordinator() \
+                else None
             # agree on readability first: if the coordinator raised while
             # the others sat in the broadcast collective, the job would
             # hang with no pointer to the cause
-            readable = int(multihost_utils.broadcast_one_to_all(
-                np.asarray(int(os.path.exists(path)), np.int32)))
+            readable = int(run_collective(
+                "restore.readable", lambda: multihost_utils.
+                broadcast_one_to_all(np.asarray(int(path is not None),
+                                                np.int32))))
             if not readable:
                 raise FileNotFoundError(
-                    f"coordinator has no checkpoint at {path}")
+                    f"coordinator has no valid checkpoint in {ckpt_dir}")
             if is_coordinator():
                 with open(path, "rb") as f:
                     host = serialization.from_bytes(template, f.read())
             else:
                 host = template
-            restored = multihost_utils.broadcast_one_to_all(host)
+            restored = run_collective(
+                "restore.broadcast",
+                lambda: multihost_utils.broadcast_one_to_all(host))
         else:
+            path = latest_valid_checkpoint(ckpt_dir)
+            if path is None:
+                raise FileNotFoundError(
+                    f"no valid checkpoint in {ckpt_dir}")
             with open(path, "rb") as f:
                 restored = serialization.from_bytes(template, f.read())
         put = lambda new, old: jax.device_put(new, old.sharding) \

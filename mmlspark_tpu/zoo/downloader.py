@@ -185,11 +185,11 @@ class RemoteRepo:
         self.read_timeout = read_timeout
 
     def _fetch(self, rel: str, timeout: Optional[float] = None) -> bytes:
-        import urllib.request
+        # resilience-layer fetch: retry/backoff + the host's circuit
+        # breaker, same policy surface as io/remote.py
+        from mmlspark_tpu.resilience.net import fetch_url
         url = f"{self.base_url}/{rel}"
-        with urllib.request.urlopen(
-                url, timeout=timeout or self.connect_timeout) as r:
-            return r.read()
+        return fetch_url(url, timeout=timeout or self.connect_timeout)
 
     def list_schemas(self) -> Iterable[ModelSchema]:
         manifest = self._fetch("MANIFEST").decode().split()
@@ -212,10 +212,8 @@ class RemoteRepo:
                     f"refusing non-http(s) payload uri: {uri!r}")
         try:
             if "://" in uri:
-                import urllib.request
-                with urllib.request.urlopen(
-                        uri, timeout=self.read_timeout) as r:
-                    return r.read()
+                from mmlspark_tpu.resilience.net import fetch_url
+                return fetch_url(uri, timeout=self.read_timeout)
             # large payloads get the (longer) read window
             return self._fetch(uri, timeout=self.read_timeout)
         except Exception as e:

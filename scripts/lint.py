@@ -6,7 +6,11 @@ scalastyle + -Xfatal-warnings into every build, src/project/build.scala:47-58
 Checks, per file:
   * unused imports (conservative: a name imported but never referenced;
     `__init__.py` re-export surfaces and `# noqa` lines are exempt)
-  * bare `except:` clauses
+  * bare `except:` clauses — outside `mmlspark_tpu/resilience/`, whose
+    retry loop intentionally catches-then-classifies
+  * direct `urllib.request.urlopen` calls outside `mmlspark_tpu/resilience/`
+    — raw network I/O must go through the policy layer (retry/backoff,
+    circuit breaker, chaos hooks in `resilience/net.py`), never around it
   * tabs in indentation
 """
 
@@ -18,6 +22,33 @@ import sys
 
 ROOTS = ["mmlspark_tpu", "tests", "examples", "scripts",
          "bench.py", "__graft_entry__.py"]
+
+# the one package allowed to touch raw sockets/signals directly: it IS
+# the policy layer everything else is required to go through
+RESILIENCE_DIR = os.path.join("mmlspark_tpu", "resilience")
+
+
+def _in_resilience(path: str) -> bool:
+    return os.path.normpath(path).startswith(RESILIENCE_DIR + os.sep)
+
+
+def _is_urlopen_call(node: ast.Call) -> bool:
+    """Matches `urllib.request.urlopen(...)`, `request.urlopen(...)`, and
+    a bare `urlopen(...)` from `from urllib.request import urlopen`."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "urlopen"
+    if isinstance(fn, ast.Attribute) and fn.attr == "urlopen":
+        parts = []
+        inner = fn.value
+        while isinstance(inner, ast.Attribute):
+            parts.append(inner.attr)
+            inner = inner.value
+        if isinstance(inner, ast.Name):
+            parts.append(inner.id)
+        dotted = ".".join(reversed(parts))
+        return dotted in ("urllib.request", "request")
+    return False
 
 
 def iter_py(paths):
@@ -60,9 +91,17 @@ def check_file(path: str) -> list[str]:
     except SyntaxError as e:
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
 
+    in_resilience = _in_resilience(path)
     for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
+        if isinstance(node, ast.ExceptHandler) and node.type is None \
+                and not in_resilience:
             problems.append(f"{path}:{node.lineno}: bare except:")
+        if isinstance(node, ast.Call) and _is_urlopen_call(node) \
+                and not in_resilience:
+            problems.append(
+                f"{path}:{node.lineno}: direct urllib.request.urlopen — "
+                f"use the resilience policy layer "
+                f"(mmlspark_tpu.resilience.net.fetch_url/http_get)")
 
     if os.path.basename(path) != "__init__.py":
         used = used_names(tree)

@@ -1,0 +1,499 @@
+"""Resilience subsystem (mmlspark_tpu/resilience/): retry/backoff,
+circuit breaking, chaos injection, checkpoint rotation, preemption,
+and bounded collectives — all driven deterministically on a VirtualClock
+(zero wall-clock sleeps; the backoff schedule is asserted, not waited on).
+"""
+
+import email.message
+import os
+import urllib.error
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import config
+from mmlspark_tpu.observe.metrics import (counters_metric_data, get_counter,
+                                          reset_counters)
+from mmlspark_tpu.resilience import (ChaosInjector, CircuitBreaker,
+                                     CircuitOpenError, Preempted,
+                                     PreemptionGuard, RetryBudgetExceeded,
+                                     RetryPolicy, VirtualClock,
+                                     default_classify, get_breaker,
+                                     latest_valid_checkpoint,
+                                     list_checkpoints, reset_breakers,
+                                     reset_chaos, retryable_status,
+                                     set_clock, write_checkpoint)
+from mmlspark_tpu.resilience.chaos import (InjectedNetworkError,
+                                           InjectedStallError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    reset_counters()
+    reset_breakers()
+    reset_chaos()
+    yield
+    reset_counters()
+    reset_breakers()
+    reset_chaos()
+
+
+@pytest.fixture
+def vclock():
+    clock = VirtualClock()
+    previous = set_clock(clock)
+    yield clock
+    set_clock(previous)
+
+
+@pytest.fixture
+def override():
+    names = []
+
+    def _set(name, value):
+        config.set(name, value)
+        names.append(name)
+
+    yield _set
+    for name in names:
+        config.set(name, None)
+
+
+def _http_error(code, retry_after=None):
+    headers = email.message.Message()
+    if retry_after is not None:
+        headers["Retry-After"] = str(retry_after)
+    return urllib.error.HTTPError("http://x/y", code, "err", headers, None)
+
+
+# ----------------------------------------------------------------- retry ---
+
+def test_retry_recovers_after_transient_failures(vclock):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_s=1.0, seed=0, name="t")
+    assert policy.call(flaky) == "ok"
+    assert calls["n"] == 3
+    assert get_counter("t.attempts") == 3
+    assert get_counter("t.retries") == 2
+    assert get_counter("t.recovered") == 1
+    # two backoffs slept, each under its full-jitter ceiling (1s, 2s)
+    assert len(vclock.sleeps) == 2
+    assert 0.0 <= vclock.sleeps[0] <= 1.0 and 0.0 <= vclock.sleeps[1] <= 2.0
+
+
+def test_jitter_is_deterministic_per_seed(vclock):
+    def fail():
+        raise TimeoutError("always")
+
+    schedules = []
+    for _ in range(2):
+        clock = VirtualClock()
+        set_clock(clock)
+        with pytest.raises(RetryBudgetExceeded):
+            RetryPolicy(max_attempts=4, base_s=2.0, seed=42,
+                        total_deadline_s=1e9).call(fail)
+        schedules.append(tuple(clock.sleeps))
+    assert schedules[0] == schedules[1] and len(schedules[0]) == 3
+
+
+def test_non_retryable_4xx_fails_fast(vclock):
+    calls = {"n": 0}
+
+    def forbidden():
+        calls["n"] += 1
+        raise _http_error(403)
+
+    with pytest.raises(urllib.error.HTTPError):
+        RetryPolicy(max_attempts=5, seed=0, name="t").call(forbidden)
+    assert calls["n"] == 1          # no backoff budget burned on auth errors
+    assert vclock.sleeps == []
+    assert get_counter("t.non_retryable") == 1
+
+
+def test_retryable_status_classification():
+    assert retryable_status(500) and retryable_status(503)
+    assert retryable_status(408) and retryable_status(429)
+    assert not retryable_status(400) and not retryable_status(403)
+    assert not retryable_status(404) and not retryable_status(200)
+    assert not default_classify(ValueError("not a fault"))
+    assert default_classify(TimeoutError("t"))
+
+
+def test_attempts_budget_raises_with_cause(vclock):
+    def fail():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        RetryPolicy(max_attempts=3, base_s=0.1, seed=0,
+                    name="t").call(fail)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert get_counter("t.giveup") == 1
+
+
+def test_total_deadline_budget(vclock):
+    def fail():
+        vclock.advance(4.0)  # each attempt costs 4s of (virtual) work
+        raise TimeoutError("slow death")
+
+    with pytest.raises(RetryBudgetExceeded):
+        RetryPolicy(max_attempts=100, base_s=0.1, seed=0,
+                    total_deadline_s=10.0).call(fail)
+    # the policy must stop near the deadline, nowhere near 100 attempts
+    assert vclock.now < 15.0
+
+
+def test_retry_after_header_overrides_backoff(vclock):
+    calls = {"n": 0}
+
+    def throttled():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _http_error(503, retry_after=7)
+        return "ok"
+
+    assert RetryPolicy(max_attempts=3, base_s=0.01,
+                       seed=0).call(throttled) == "ok"
+    assert vclock.sleeps == [7.0]  # the server's wait, not the jitter
+
+
+def test_attempt_deadline_passed_to_callable(vclock):
+    seen = []
+
+    def fn(timeout=None):
+        seen.append(timeout)
+        return "ok"
+
+    RetryPolicy(attempt_deadline_s=5.0, total_deadline_s=100.0,
+                seed=0).call(fn)
+    assert seen == [5.0]
+
+
+def test_policy_from_config(override):
+    override("MMLSPARK_TPU_RETRY_MAX_ATTEMPTS", 2)
+    override("MMLSPARK_TPU_RETRY_BASE_S", 0.25)
+    policy = RetryPolicy.from_config(name="x")
+    assert policy.max_attempts == 2 and policy.base_s == 0.25
+    assert policy.name == "x"
+
+
+# --------------------------------------------------------------- breaker ---
+
+def test_breaker_opens_after_consecutive_failures(vclock):
+    b = CircuitBreaker("host:1", threshold=3, reset_s=30.0)
+    for _ in range(3):
+        b.allow()
+        b.record_failure(ConnectionError("x"))
+    with pytest.raises(CircuitOpenError) as ei:
+        b.allow()
+    assert "host:1" in str(ei.value)
+    assert get_counter("breaker.opened") == 1
+    assert get_counter("breaker.refused") == 1
+
+
+def test_breaker_half_open_probe_closes_on_success(vclock):
+    b = CircuitBreaker("h", threshold=1, reset_s=10.0)
+    b.record_failure(ConnectionError("x"))
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    vclock.advance(10.0)
+    b.allow()               # the half-open probe is admitted
+    b.record_success()
+    b.allow()               # closed again: normal traffic flows
+    assert get_counter("breaker.half_open") == 1
+    assert get_counter("breaker.closed") == 1
+
+
+def test_breaker_failed_probe_reopens(vclock):
+    b = CircuitBreaker("h", threshold=1, reset_s=10.0)
+    b.record_failure(ConnectionError("x"))
+    vclock.advance(10.0)
+    b.allow()                                  # probe
+    b.record_failure(ConnectionError("still dead"))
+    with pytest.raises(CircuitOpenError):
+        b.allow()                              # cooldown restarted
+    vclock.advance(10.0)
+    b.allow()                                  # next probe window
+
+
+def test_success_resets_consecutive_count(vclock):
+    b = CircuitBreaker("h", threshold=2, reset_s=10.0)
+    b.record_failure(ConnectionError("x"))
+    b.record_success()
+    b.record_failure(ConnectionError("x"))
+    b.allow()  # 1 consecutive < 2: still closed
+
+
+def test_retry_policy_respects_open_breaker(vclock):
+    b = CircuitBreaker("dead-host", threshold=2, reset_s=60.0)
+    calls = {"n": 0}
+
+    def fail():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    policy = RetryPolicy(max_attempts=10, base_s=0.1, seed=0)
+    with pytest.raises(CircuitOpenError):
+        policy.call(fail, breaker=b)
+    # the breaker cut the retry loop short: 2 real attempts, then refusal
+    assert calls["n"] == 2
+
+
+def test_get_breaker_is_per_endpoint():
+    assert get_breaker("a") is get_breaker("a")
+    assert get_breaker("a") is not get_breaker("b")
+
+
+# ----------------------------------------------------------------- chaos ---
+
+def test_chaos_is_deterministic_per_seed():
+    def pattern(seed):
+        inj = ChaosInjector(seed=seed, net_error_rate=0.5)
+        out = []
+        for _ in range(32):
+            try:
+                inj.on_request("http://x")
+                out.append(0)
+            except InjectedNetworkError:
+                out.append(1)
+        return out
+
+    assert pattern(7) == pattern(7)
+    assert pattern(7) != pattern(8)
+    assert sum(pattern(7)) > 0
+
+
+def test_chaos_stall_spends_virtual_time(vclock):
+    inj = ChaosInjector(seed=0, stall_rate=1.0, stall_s=30.0)
+    with pytest.raises(InjectedStallError):
+        inj.on_request("http://x")
+    assert vclock.now == 30.0 and vclock.sleeps == [30.0]
+
+
+def test_chaos_tear_file_truncates(tmp_path):
+    p = str(tmp_path / "f.bin")
+    with open(p, "wb") as f:
+        f.write(b"x" * 1000)
+    ChaosInjector.tear_file(p)
+    assert 0 < os.path.getsize(p) < 1000
+
+
+def test_chaos_preemption_fires_sigterm_once():
+    inj = ChaosInjector(seed=0, preempt_at_step=5)
+    with PreemptionGuard() as guard:
+        inj.on_step(4)
+        assert not guard.triggered
+        inj.on_step(5)
+        assert guard.triggered
+        guard.triggered = False
+        inj.on_step(6)               # one-shot: no second signal
+        assert not guard.triggered
+    assert get_counter("chaos.preemptions") == 1
+
+
+def test_chaos_off_by_default():
+    inj = ChaosInjector()
+    assert not inj.active
+    for step in range(100):
+        inj.on_step(step)
+        inj.on_request("http://x")   # never raises
+
+
+# ------------------------------------------------------------ preemption ---
+
+def test_preemption_guard_restores_handler():
+    import signal
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert signal.getsignal(signal.SIGTERM) != before
+        guard.request()
+        assert guard.triggered
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_preemption_guard_install_false_leaves_signals_alone():
+    import signal
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(install=False):
+        assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_preempted_carries_context():
+    e = Preempted(step=17, ckpt_dir="/ckpt")
+    assert e.step == 17 and e.ckpt_dir == "/ckpt"
+    assert "resume=True" in str(e)
+
+
+# ----------------------------------------------------------- checkpoints ---
+
+def test_rotation_keeps_last_k_with_latest_pointer(tmp_path):
+    d = str(tmp_path)
+    for step in range(1, 6):
+        write_checkpoint(d, step, f"payload-{step}".encode(), keep=3)
+    steps = [s for s, _ in list_checkpoints(d)]
+    assert steps == [5, 4, 3]
+    with open(os.path.join(d, "LATEST")) as f:
+        assert f.read().strip().endswith("0000000005.msgpack")
+    newest = latest_valid_checkpoint(d)
+    with open(newest, "rb") as f:
+        assert f.read() == b"payload-5"
+
+
+def test_torn_checkpoint_skipped_not_crashed_on(tmp_path):
+    d = str(tmp_path)
+    for step in (1, 2, 3):
+        write_checkpoint(d, step, f"payload-{step}".encode(), keep=5)
+    newest = os.path.join(d, "ckpt_0000000003.msgpack")
+    ChaosInjector.tear_file(newest, keep_fraction=0.3)
+    best = latest_valid_checkpoint(d)
+    with open(best, "rb") as f:
+        assert f.read() == b"payload-2"
+    assert get_counter("checkpoint.skipped_corrupt") >= 1
+
+
+def test_stale_latest_pointer_is_not_trusted(tmp_path):
+    d = str(tmp_path)
+    write_checkpoint(d, 1, b"good", keep=5)
+    with open(os.path.join(d, "LATEST"), "w") as f:
+        f.write("ckpt_0000000099.msgpack")  # points at nothing
+    best = latest_valid_checkpoint(d)
+    with open(best, "rb") as f:
+        assert f.read() == b"good"
+
+
+def test_legacy_single_file_layout_accepted(tmp_path):
+    d = str(tmp_path)
+    legacy = os.path.join(d, "checkpoint.msgpack")
+    with open(legacy, "wb") as f:
+        f.write(b"old-layout")
+    assert latest_valid_checkpoint(d) == legacy
+
+
+def test_empty_dir_has_no_checkpoint(tmp_path):
+    assert latest_valid_checkpoint(str(tmp_path)) is None
+    assert latest_valid_checkpoint(str(tmp_path / "missing")) is None
+
+
+def test_chaos_torn_checkpoint_rate_hooks_into_write(tmp_path, override):
+    override("MMLSPARK_TPU_CHAOS_TORN_CKPT_RATE", 1.0)
+    reset_chaos()
+    d = str(tmp_path)
+    write_checkpoint(d, 1, b"will-be-torn" * 10, keep=5)
+    assert get_counter("chaos.torn_files") == 1
+    assert latest_valid_checkpoint(d) is None  # torn AND detected
+
+
+# ------------------------------------------------------------ collectives ---
+
+def test_run_collective_single_process_is_direct():
+    from mmlspark_tpu.parallel.distributed import (barrier, health_check,
+                                                   run_collective)
+    assert run_collective("op", lambda: 41 + 1) == 42
+    barrier("tag")                    # trivially passes single-process
+    assert health_check() == [0]
+
+
+def test_run_collective_times_out_with_named_diagnostic(monkeypatch):
+    import jax
+
+    from mmlspark_tpu.parallel import distributed
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    import threading
+    hang = threading.Event()
+    with pytest.raises(distributed.CollectiveTimeoutError) as ei:
+        distributed.run_collective("restore.broadcast",
+                                   lambda: hang.wait(5.0), timeout_s=0.05)
+    hang.set()
+    msg = str(ei.value)
+    assert "restore.broadcast" in msg and "resume=True" in msg
+    assert get_counter("collective.timeouts") == 1
+
+
+def test_run_collective_propagates_worker_error(monkeypatch):
+    import jax
+
+    from mmlspark_tpu.parallel import distributed
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    def boom():
+        raise ValueError("worker died")
+
+    with pytest.raises(ValueError, match="worker died"):
+        distributed.run_collective("op", boom, timeout_s=5.0)
+
+
+# ------------------------------------------------------------- counters ---
+
+def test_counters_flow_through_metric_contract():
+    from mmlspark_tpu.observe.metrics import inc_counter
+    inc_counter("a.b", 2.0)
+    inc_counter("a.b")
+    md = counters_metric_data()
+    assert md.metric_type == "counters"
+    assert md.scalars()["a.b"] == 3.0
+
+
+# ------------------------------------------------------ on_error policy ---
+
+def test_on_error_domain_enforced():
+    from mmlspark_tpu.core.params import ParamError
+    from mmlspark_tpu.core.pipeline import Transformer, check_on_error
+    with pytest.raises(ValueError):
+        check_on_error("explode")
+    t = Transformer()
+    assert t.on_error == "fail"
+    with pytest.raises(ParamError):
+        t.on_error = "explode"
+    t.on_error = "column"
+    assert t.on_error == "column"
+
+
+@pytest.fixture
+def mixed_image_dir(tmp_path):
+    import io as _io
+
+    from PIL import Image
+    for i, value in enumerate((10, 200)):
+        buf = _io.BytesIO()
+        Image.new("RGB", (4, 4), (value, value, value)).save(buf, "PNG")
+        (tmp_path / f"img_{i}.png").write_bytes(buf.getvalue())
+    (tmp_path / "img_1a_bad.png").write_bytes(b"definitely not a png")
+    return str(tmp_path)
+
+
+def test_read_images_on_error_column(mixed_image_dir):
+    from mmlspark_tpu.io.image_reader import read_images
+    t = read_images(mixed_image_dir, on_error="column")
+    assert t.num_rows == 3                       # the bad row is KEPT
+    errs = list(t["decode_error"])
+    assert sum(e is not None for e in errs) == 1
+    bad = errs.index(next(e for e in errs if e is not None))
+    assert "could not decode" in errs[bad]
+    assert t["image"].shape == (3, 4, 4, 3)      # dense batch preserved
+    assert not np.asarray(t["image"][bad]).any()  # placeholder is zeros
+
+
+def test_read_images_on_error_fail_and_skip(mixed_image_dir):
+    from mmlspark_tpu.io.image_reader import read_images
+    with pytest.raises(ValueError, match="could not decode"):
+        read_images(mixed_image_dir, on_error="fail")
+    t = read_images(mixed_image_dir, on_error="skip")
+    assert t.num_rows == 2
+
+
+def test_read_images_iter_on_error_column(mixed_image_dir):
+    from mmlspark_tpu.io.image_reader import read_images_iter
+    batches = list(read_images_iter(mixed_image_dir, batch_size=2,
+                                    resize_to=(4, 4), on_error="column"))
+    assert sum(b.num_rows for b in batches) == 3
+    errs = [e for b in batches for e in b["decode_error"]]
+    assert sum(e is not None for e in errs) == 1

@@ -124,6 +124,81 @@ def test_checkpoint_save_restore(tmp_path):
     assert int(restored.step) == int(bundle.metadata["steps"])
 
 
+def test_preemption_resume_matches_fault_free_run(tmp_path):
+    """The acceptance scenario: under chaos (one simulated SIGTERM mid-
+    run), fit_arrays with ckpt_dir+resume finishes with the SAME final
+    step count — and, with a fixed data order, the same final weights and
+    loss — as a fault-free run."""
+    from mmlspark_tpu import config
+    from mmlspark_tpu.resilience import Preempted, reset_chaos
+
+    x, y = two_blob_data(n=128)
+    cfg = mlp_config(epochs=4, batch_size=64, shuffle_each_epoch=False)
+    ref_trainer = Trainer(cfg)
+    ref = ref_trainer.fit_arrays(x, y)          # fault-free reference
+    assert ref.metadata["steps"] == 8           # 2 steps/epoch x 4 epochs
+
+    ckpt = str(tmp_path / "ckpt")
+    config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", 5)
+    reset_chaos()
+    try:
+        with pytest.raises(Preempted) as ei:
+            Trainer(cfg).fit_arrays(x, y, ckpt_dir=ckpt, resume=True)
+        # SIGTERM landed at step 5; the in-flight step finished first
+        assert ei.value.step == 6
+        assert ei.value.ckpt_dir == ckpt
+    finally:
+        config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", None)
+        reset_chaos()
+
+    resumed_trainer = Trainer(cfg)
+    resumed = resumed_trainer.fit_arrays(x, y, ckpt_dir=ckpt, resume=True)
+    assert resumed.metadata["steps"] == ref.metadata["steps"]
+    # loss continuity: the resumed run's final epoch saw exactly the
+    # batches the preempted run never reached — identical numbers
+    np.testing.assert_allclose(resumed_trainer.history[-1]["loss"],
+                               ref_trainer.history[-1]["loss"], rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(resumed.variables["params"]["dense0"]["kernel"]),
+        np.asarray(ref.variables["params"]["dense0"]["kernel"]), atol=1e-6)
+
+
+def test_resume_skips_torn_checkpoint(tmp_path):
+    """A torn newest checkpoint (chaos) is skipped by checksum; restore
+    falls back to the next valid one instead of crashing."""
+    from mmlspark_tpu.resilience import ChaosInjector, list_checkpoints
+
+    x, y = two_blob_data(n=128)
+    ckpt = str(tmp_path / "ckpt")
+    cfg = mlp_config(epochs=2, batch_size=64, shuffle_each_epoch=False,
+                     checkpoint_dir=ckpt, checkpoint_every_steps=1)
+    Trainer(cfg).fit_arrays(x, y)               # steps 1..4 checkpointed
+    steps = [s for s, _ in list_checkpoints(ckpt)]
+    assert steps == [4, 3, 2]                   # keep-last-K rotation (K=3)
+    newest = list_checkpoints(ckpt)[0][1]
+    ChaosInjector.tear_file(newest)
+    trainer = Trainer(mlp_config())
+    state = trainer.init_state((1, 4), total_steps=1)
+    restored = trainer.restore_checkpoint(state, ckpt)
+    assert int(restored.step) == 3              # fell back past the tear
+
+
+def test_resume_with_completed_run_is_idempotent(tmp_path):
+    """resume=True over a finished run replays nothing and returns the
+    same step count (restart-after-success must be harmless)."""
+    x, y = two_blob_data(n=128)
+    ckpt = str(tmp_path / "ckpt")
+    cfg = mlp_config(epochs=2, batch_size=64, shuffle_each_epoch=False)
+    first = Trainer(cfg).fit_arrays(x, y, ckpt_dir=ckpt)
+    again_trainer = Trainer(cfg)
+    again = again_trainer.fit_arrays(x, y, ckpt_dir=ckpt, resume=True)
+    assert again.metadata["steps"] == first.metadata["steps"] == 4
+    np.testing.assert_allclose(
+        np.asarray(again.variables["params"]["dense0"]["kernel"]),
+        np.asarray(first.variables["params"]["dense0"]["kernel"]),
+        atol=1e-7)
+
+
 def test_regression_mse_loss():
     rng = np.random.default_rng(0)
     x = rng.normal(size=(256, 3)).astype(np.float32)

@@ -36,5 +36,12 @@ from mmlspark_tpu.core.pipeline import (
     load_stage,
 )
 from mmlspark_tpu.core.table import DataTable
-from mmlspark_tpu.observe import (MetricData, get_logger, profile,
-                                  stage_timing)
+from mmlspark_tpu.observe import (MetricData, get_logger, pipeline_timing,
+                                  profile, stage_timing)
+
+# persistent XLA compilation cache (MMLSPARK_TPU_COMPILATION_CACHE): wired
+# before any model compiles so warm restarts skip recompiles entirely
+from mmlspark_tpu.config import setup_compilation_cache as _setup_cc
+
+_setup_cc()
+del _setup_cc

@@ -138,3 +138,36 @@ TEST_BUDGET_S = register(
     "MMLSPARK_TPU_TEST_BUDGET_S", default=30.0, ptype=_floatp,
     doc="Per-test duration alert budget in seconds (reference "
         "TestBase.scala:65 alerts at 3s; XLA compiles are ~10x that).")
+
+COMPILATION_CACHE = register(
+    "MMLSPARK_TPU_COMPILATION_CACHE", default=None,
+    doc="Directory for JAX's persistent XLA compilation cache; when set, "
+        "warm restarts (resume-after-preemption, repeated bench runs) load "
+        "compiled executables from disk instead of re-lowering "
+        "(docs/performance.md). Unset: in-memory jit cache only.")
+
+
+def setup_compilation_cache() -> Any:
+    """Point JAX's persistent compilation cache at the configured directory.
+
+    Called at package import (mmlspark_tpu/__init__.py) and safe to call
+    again after `set('MMLSPARK_TPU_COMPILATION_CACHE', ...)`.  Returns the
+    effective directory, or None when the knob is unset or this JAX build
+    has no persistent-cache support (older builds: silently skipped — the
+    cache is an optimization, never a requirement).
+    """
+    path = COMPILATION_CACHE.current()
+    if not path:
+        return None
+    path = os.path.abspath(os.path.expanduser(str(path)))
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every executable: the default thresholds skip sub-second
+        # compiles, but warm-restart wins here come precisely from the many
+        # small per-shape programs the scoring/training loops accumulate
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        return None
+    return path

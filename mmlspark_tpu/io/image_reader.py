@@ -22,6 +22,8 @@ from mmlspark_tpu.core.schema import ColumnMeta, ImageSchema
 from mmlspark_tpu.core.table import DataTable, object_column
 from mmlspark_tpu.io.files import iter_binary_files, read_binary_files
 from mmlspark_tpu.native_loader import native_decode, native_decode_batch
+from mmlspark_tpu.observe.spans import active_timings, span_on
+from mmlspark_tpu.parallel.prefetch import Prefetcher, default_depth
 
 
 def _resolve_on_error(on_error: Optional[str], drop_failures: bool) -> str:
@@ -211,14 +213,37 @@ def read_images_iter(path: str, batch_size: int = 256,
     paths: list = []
     images: list = []
     errors: list = []
-    pend_paths: list = []
-    pend_bufs: list = []
     first_shape: Optional[tuple] = None
 
-    def decode_pending() -> None:
+    def raw_batches():
+        # file enumeration + reads stay sequential (ordering is part of
+        # the contract); each yielded chunk is one decode unit
+        pend_paths: list = []
+        pend_bufs: list = []
+        for p, data in iter_binary_files(path, recursive=recursive,
+                                         sample_ratio=sample_ratio,
+                                         inspect_zip=inspect_zip,
+                                         pattern=pattern, seed=seed):
+            pend_paths.append(p)
+            pend_bufs.append(data)
+            if len(pend_bufs) >= batch_size:
+                yield pend_paths, pend_bufs
+                pend_paths, pend_bufs = [], []
+        if pend_bufs:
+            yield pend_paths, pend_bufs
+
+    def decode_batch(item):
+        # runs on the prefetcher's staging threads: the NEXT batch decodes
+        # (C++ pool / PIL fallback) while the consumer resizes, assembles,
+        # and the caller scores the current one.  Per-row policy checks
+        # stay on the consumer thread so failures surface in row order.
+        batch_paths, bufs = item
+        with span_on(timings, "host"):
+            return batch_paths, decode_many(bufs)
+
+    def absorb(batch_paths: list, decoded: list) -> None:
         nonlocal first_shape
-        decoded = decode_many(pend_bufs)
-        for p, img in zip(pend_paths, decoded):
+        for p, img in zip(batch_paths, decoded):
             if img is None:
                 if policy == "skip":
                     continue
@@ -246,8 +271,6 @@ def read_images_iter(path: str, batch_size: int = 256,
                         f"{first_shape}")
             paths.append(p)
             images.append(img)
-        pend_paths.clear()
-        pend_bufs.clear()
 
     def flush(k: int) -> DataTable:
         nonlocal paths, images, errors
@@ -260,16 +283,17 @@ def read_images_iter(path: str, batch_size: int = 256,
             if resize_to is not None else batch,
             batch_errors if policy == "column" else None)
 
-    for p, data in iter_binary_files(path, recursive=recursive,
-                                     sample_ratio=sample_ratio,
-                                     inspect_zip=inspect_zip,
-                                     pattern=pattern, seed=seed):
-        pend_paths.append(p)
-        pend_bufs.append(data)
-        if len(pend_bufs) >= batch_size:
-            decode_pending()  # one parallel C++ decode per batch
+    timings = active_timings()
+    # bounded decode lookahead: peak residency is `depth` decoded batches
+    # plus the accumulation buffer, so corpora stay unbounded by host RAM
+    staged = Prefetcher(decode_batch, raw_batches(), depth=default_depth(),
+                        name="decode")
+    try:
+        for batch_paths, decoded in staged:
+            absorb(batch_paths, decoded)
             while len(images) >= batch_size:
                 yield flush(batch_size)
-    decode_pending()
-    while images:
-        yield flush(batch_size)
+        while images:
+            yield flush(batch_size)
+    finally:
+        staged.close()

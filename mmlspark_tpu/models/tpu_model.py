@@ -28,8 +28,11 @@ from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
-from mmlspark_tpu.parallel.bridge import pad_to_multiple, replicate_tree
+from mmlspark_tpu.observe.spans import active_timings, span_on
+from mmlspark_tpu.parallel.bridge import (pad_to_multiple, put_sharded,
+                                          replicate_tree, reshard)
 from mmlspark_tpu.parallel.mesh import batch_sharding, best_mesh, replicated
+from mmlspark_tpu.parallel.prefetch import OncePerTable, Prefetcher, default_depth
 
 
 class TPUModel(Transformer):
@@ -44,6 +47,12 @@ class TPUModel(Transformer):
         validator=lambda v: v > 0)
     outputNodeName = Param(None, "named node to output (None = final)", ptype=str)
     outputNodeIndex = Param(None, "index into the ordered named nodes", ptype=int)
+    prefetchDepth = Param(
+        None, "pipeline depth: staged batches in flight (host prep + "
+        "device_put overlap the compiled forward); None defers to "
+        "MMLSPARK_TPU_PREFETCH_DEPTH, 0 disables overlap entirely "
+        "(synchronous per-batch round trips)", ptype=int,
+        validator=lambda v: v >= 0)
 
     def __init__(self, bundle: Optional[ModelBundle] = None, **kwargs):
         super().__init__(**kwargs)
@@ -154,6 +163,12 @@ class TPUModel(Transformer):
         bs = max(self.miniBatchSize, mesh.shape["data"])
         return bs - bs % mesh.shape["data"] or mesh.shape["data"]
 
+    def _prefetch_depth(self) -> int:
+        """The pipeline depth every dispatch loop uses: the Param when set,
+        else the MMLSPARK_TPU_PREFETCH_DEPTH config default."""
+        depth = self.prefetchDepth
+        return default_depth() if depth is None else max(0, depth)
+
     @staticmethod
     def _tensor_column(col: np.ndarray) -> np.ndarray:
         if col.dtype == object:
@@ -193,9 +208,13 @@ class TPUModel(Transformer):
         sharding = batch_sharding(mesh)
 
         # CheckpointData fast path: the column is already HBM-resident —
-        # batches are on-device slices (re-sharded, no host transfer), with
-        # the same windowed async-fetch pipeline as the streaming loop.
-        window = 8
+        # batches are on-device slices (a no-op re-shard when CheckpointData
+        # staged with the mesh batch sharding, stages/basic.py), with the
+        # same windowed async-fetch pipeline as the streaming loop.  The
+        # cached array may carry divisibility padding, so valid counts come
+        # from the HOST column's length, never the device shape.
+        window = self._prefetch_depth()
+        timings = active_timings()
         n = len(col)
         in_flight: list[tuple[Any, int]] = []
         results: list[np.ndarray] = []
@@ -203,16 +222,20 @@ class TPUModel(Transformer):
         def drain(count: int):
             while len(in_flight) > count:
                 out, valid = in_flight.pop(0)
-                results.append(np.asarray(out)[:valid])
+                with span_on(timings, "drain"):
+                    results.append(np.asarray(out)[:valid])
 
         for start in range(0, n, bs):
-            chunk = dev_col[start:start + bs]
-            valid = int(chunk.shape[0])
-            if valid < bs:
-                pad = [(0, bs - valid)] + [(0, 0)] * (chunk.ndim - 1)
-                chunk = jnp.pad(chunk, pad)
-            dev = jax.device_put(chunk, sharding)  # on-device reshard
-            out = apply_fn(variables, dev)
+            valid = min(bs, n - start)
+            with span_on(timings, "transfer"):
+                chunk = dev_col[start:start + bs]
+                if int(chunk.shape[0]) < bs:
+                    pad = [(0, bs - int(chunk.shape[0]))] \
+                        + [(0, 0)] * (chunk.ndim - 1)
+                    chunk = jnp.pad(chunk, pad)
+                dev = reshard(chunk, sharding)  # on-device reshard
+            with span_on(timings, "compute"):
+                out = apply_fn(variables, dev)
             try:
                 out.copy_to_host_async()
             except (AttributeError, RuntimeError):
@@ -246,6 +269,13 @@ class TPUModel(Transformer):
         transfer link never drains between tables, unlike calling
         `transform` per table, which would pay a full round-trip flush each
         time (ruinous over high-latency links).
+
+        The host half of every batch — `_tensor_column` stacking, padding,
+        and the host->HBM `device_put` — runs on the `Prefetcher`'s staging
+        threads, overlapping the compiled forward of earlier batches; the
+        dispatch thread only launches `apply_fn` and drains results.
+        `prefetchDepth` bounds staged + in-flight batches (backpressure),
+        and depth 0 collapses to the serial alternating loop.
         """
         self._check_required()
         in_col = self.inputCol
@@ -260,15 +290,47 @@ class TPUModel(Transformer):
                 yield self.transform(table)
             return
         sharding = batch_sharding(mesh)
-        window = 8
+        depth = self._prefetch_depth()
+        timings = active_timings()  # captured HERE: workers have no context
         in_flight: list[tuple[Any, int, dict]] = []
         ready: list[DataTable] = []
         pending: list[dict] = []
 
+        def plans():
+            # one item per minibatch, in strict (table, batch) order; the
+            # expensive np.stack is NOT done here — each table carries a
+            # OncePerTable so the first staged batch pays it once, on a
+            # staging thread
+            for table in tables:
+                n = len(table[in_col])
+                column = OncePerTable(
+                    lambda t=table: self._tensor_column(t[in_col]))
+                if n == 0:
+                    yield ("empty", {"table": table}, column, 0)
+                    continue
+                rec = {"table": table, "parts": [], "n_left": -(-n // bs)}
+                for start in range(0, n, bs):
+                    yield ("batch", rec, column, start)
+
+        def stage(item):
+            kind, rec, column, start = item
+            if kind == "empty":
+                rec["n_left"] = 0
+                rec["parts"] = [self._empty_output(
+                    column.get(), variables, apply_fn, bs)]
+                return ("empty", rec, None, 0)
+            with span_on(timings, "host"):
+                col = column.get()
+                chunk, valid = pad_to_multiple(col[start:start + bs], bs)
+            with span_on(timings, "transfer"):
+                dev = put_sharded(chunk, sharding)
+            return ("batch", rec, dev, valid)
+
         def drain(limit: int):
             while len(in_flight) > limit:
                 out, valid, rec = in_flight.pop(0)
-                rec["parts"].append(np.asarray(out)[:valid])
+                with span_on(timings, "drain"):
+                    rec["parts"].append(np.asarray(out)[:valid])
                 rec["n_left"] -= 1
             while pending and pending[0]["n_left"] == 0:
                 rec = pending.pop(0)
@@ -277,35 +339,36 @@ class TPUModel(Transformer):
                 ready.append(
                     rec["table"].with_column(self.outputCol, result))
 
-        for table in tables:
-            col = self._tensor_column(table[in_col])
-            n = len(col)
-            if n == 0:
-                # an empty record rides the ordered pending queue with its
-                # result pre-filled — NO drain: an interleaved empty table
-                # must not stall the cross-table pipeline
-                pending.append({"table": table, "n_left": 0, "parts": [
-                    self._empty_output(col, variables, apply_fn, bs)]})
-                drain(len(in_flight))  # flush only already-finished records
-            else:
-                rec = {"table": table, "parts": [],
-                       "n_left": -(-n // bs)}
-                pending.append(rec)
-                for start in range(0, n, bs):
-                    chunk, valid = pad_to_multiple(col[start:start + bs], bs)
-                    dev = jax.device_put(chunk, sharding)
-                    out = apply_fn(variables, dev)
+        staged = Prefetcher(stage, plans(), depth=depth, name="score")
+        try:
+            for kind, rec, dev, valid in staged:
+                if rec.get("queued") is None:
+                    # first staged batch of this record: results arrive in
+                    # plan order, so pending stays in table order
+                    rec["queued"] = True
+                    pending.append(rec)
+                if kind == "empty":
+                    # an empty record rides the ordered pending queue with
+                    # its result pre-filled — flush only finished records
+                    # (an interleaved empty table must not stall the
+                    # cross-table pipeline)
+                    drain(len(in_flight))
+                else:
+                    with span_on(timings, "compute"):
+                        out = apply_fn(variables, dev)
                     try:
                         out.copy_to_host_async()
                     except (AttributeError, RuntimeError):
                         pass
                     in_flight.append((out, valid, rec))
-                    drain(window)
+                    drain(depth)
+                while ready:
+                    yield ready.pop(0)
+            drain(0)
             while ready:
                 yield ready.pop(0)
-        drain(0)
-        while ready:
-            yield ready.pop(0)
+        finally:
+            staged.close()
 
     def _transform_multihost(self, col, mesh, variables, apply_fn,
                              bs: int) -> np.ndarray:
@@ -352,27 +415,37 @@ class TPUModel(Transformer):
             np.asarray(n_local)).max() / bs_local)) or 1
         sharding = batch_sharding(mesh)
         out_spec = P(DATA_AXIS)
-        window = 8
+        # lockstep dispatch: the window is parameterized but staging stays
+        # on the dispatch thread — every process must issue the same puts
+        # and steps in the same order, so no background staging here
+        window = max(1, self._prefetch_depth())
+        timings = active_timings()
         in_flight: list[tuple[Any, int]] = []
         results: list[np.ndarray] = []
 
         def drain(count: int):
             while len(in_flight) > count:
                 out, valid = in_flight.pop(0)
-                local = multihost_utils.global_array_to_host_local_array(
-                    out, mesh, out_spec)
-                results.append(np.asarray(local)[:valid])
+                with span_on(timings, "drain"):
+                    local = multihost_utils.global_array_to_host_local_array(
+                        out, mesh, out_spec)
+                    results.append(np.asarray(local)[:valid])
 
         feed_shape = (bs_local,) + col.shape[1:]
         for step in range(n_steps):
-            chunk = col[step * bs_local:(step + 1) * bs_local]
-            valid = int(chunk.shape[0])
-            if valid < bs_local:
-                feed = np.zeros(feed_shape, col.dtype)
-                feed[:valid] = chunk
-                chunk = feed
-            dev = put_sharded(np.ascontiguousarray(chunk), sharding)
-            in_flight.append((apply_fn(variables, dev), valid))
+            with span_on(timings, "host"):
+                chunk = col[step * bs_local:(step + 1) * bs_local]
+                valid = int(chunk.shape[0])
+                if valid < bs_local:
+                    feed = np.zeros(feed_shape, col.dtype)
+                    feed[:valid] = chunk
+                    chunk = feed
+                chunk = np.ascontiguousarray(chunk)
+            with span_on(timings, "transfer"):
+                dev = put_sharded(chunk, sharding)
+            with span_on(timings, "compute"):
+                out = apply_fn(variables, dev)
+            in_flight.append((out, valid))
             drain(window)
         drain(0)
         # n_steps >= 1 always, so results is never empty (a zero-row local

@@ -7,10 +7,13 @@ from mmlspark_tpu.observe.metrics import (MetricData, counters_metric_data,
                                           counters_snapshot, get_counter,
                                           inc_counter, reset_counters)
 from mmlspark_tpu.observe.profiler import annotate, profile
+from mmlspark_tpu.observe.spans import (PipelineTimings, active_timings,
+                                        pipeline_timing, span_on)
 from mmlspark_tpu.observe.timing import (StageTimings, instrument_stage_method,
                                          stage_timing)
 
 __all__ = ["LOG_ROOT", "get_logger", "MetricData", "annotate", "profile",
            "StageTimings", "instrument_stage_method", "stage_timing",
+           "PipelineTimings", "active_timings", "pipeline_timing", "span_on",
            "inc_counter", "get_counter", "counters_snapshot",
            "reset_counters", "counters_metric_data"]

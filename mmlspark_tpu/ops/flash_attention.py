@@ -124,7 +124,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
 
     # under shard_map (ring attention) outputs must declare which mesh axes
     # they vary over; inherit the query's varying-manual-axes type
-    vma = getattr(jax.typeof(q), "vma", None)
+    vma = _vma_of(q)
     sds = (functools.partial(jax.ShapeDtypeStruct, vma=vma)
            if vma else jax.ShapeDtypeStruct)
     out_shapes = [sds((b * h, sq, d), q.dtype)]
@@ -297,7 +297,7 @@ def _flash_backward(q, k, v, do, lse, delta, causal, scale, block_q,
     qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
     koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
 
-    vma = getattr(jax.typeof(q), "vma", None)
+    vma = _vma_of(q)
     sds = (functools.partial(jax.ShapeDtypeStruct, vma=vma)
            if vma else jax.ShapeDtypeStruct)
 
@@ -415,10 +415,19 @@ def _warn_dense_fallback(fn_name: str, sq: int, sk: int, block_q: int,
         fn_name, sq, sk, block_q, block_k, reason)
 
 
+def _vma_of(x):
+    """The array type's varying-manual-axes, or None.  jax.typeof landed
+    in 0.5.x — older builds have no vma tracking, so None there."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    return getattr(typeof(x), "vma", None)
+
+
 def _in_manual_region(x) -> bool:
     """True inside a shard_map manual region (the array type carries
     varying-manual-axes); the pallas interpreter cannot run there."""
-    return bool(getattr(jax.typeof(x), "vma", None))
+    return bool(_vma_of(x))
 
 
 def _auto_interpret() -> bool:

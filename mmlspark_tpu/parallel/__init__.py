@@ -12,3 +12,4 @@ from mmlspark_tpu.parallel.bridge import (
     shard_table_columns,
 )
 from mmlspark_tpu.parallel.distributed import DistributedConfig, initialize_distributed
+from mmlspark_tpu.parallel.prefetch import Prefetcher, default_depth

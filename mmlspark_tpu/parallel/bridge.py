@@ -106,6 +106,29 @@ def gather_to_host(tree: Any, mesh: Mesh) -> Any:
     return jax.device_get(gather_replicated(tree, mesh))
 
 
+def reshard(x: Any, sharding: NamedSharding) -> jax.Array:
+    """Re-lay-out an already-device-resident array (CheckpointData cache
+    slices) onto `sharding` — an on-device transfer, never host-bounced
+    (unlike `put_sharded`, which assembles from host rows per process)."""
+    return jax.device_put(x, sharding)
+
+
+def put_tree(tree: Any, shardings: Any) -> Any:
+    """device_put every leaf onto its matching sharding (cold path: state
+    init).  Hot-loop modules use this instead of raw `jax.device_put` —
+    scripts/lint.py keeps transfers inside bridge.py/prefetch.py."""
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)
+
+
+def put_like(new: Any, old: Any) -> Any:
+    """Place `new` with `old`'s sharding (checkpoint restore: host values
+    re-committed onto the live state's layout); passthrough when `old`
+    carries no sharding (plain host leaves)."""
+    if hasattr(old, "sharding"):
+        return jax.device_put(new, old.sharding)
+    return new
+
+
 def replicate_tree(tree: Any, mesh: Mesh) -> Any:
     """Replicate a pytree (model weights) across the mesh."""
     sharding = replicated(mesh)

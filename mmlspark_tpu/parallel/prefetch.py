@@ -1,0 +1,189 @@
+"""Bounded background producer: overlap host work and transfers with compute.
+
+The scoring and training hot loops used to alternate — decode/stack/pad on
+the dispatch thread, then a synchronous `device_put`, then the jitted step —
+so the MXU idled while the host prepared the next batch.  `Prefetcher` is
+the pipelined replacement (the tf.data producer/consumer move,
+arXiv:2101.12127): a stage function runs on a small thread pool, at most
+`depth` staged batches exist at any moment (backpressure — HBM holds a
+bounded number of in-flight batches), and results are handed to the
+consumer strictly in submission order, so pipelining never reorders rows.
+
+Contract:
+
+  * **Deterministic ordering** — results come back in item order no matter
+    which worker finishes first (a FIFO of futures, not a completion queue).
+  * **Backpressure** — at most `depth` items are staged-but-unconsumed; the
+    source iterator is never advanced more than `depth` items past the
+    consumer.
+  * **Exception propagation** — a stage-function error surfaces in the
+    consumer at exactly the failed item's position (original exception,
+    earlier results undisturbed); a source-iterator error surfaces after
+    every already-staged result has been delivered.
+  * **Clean shutdown** — `close()` (also via context manager / generator
+    teardown) cancels queued work and releases the pool, so a `Preempted`
+    or any consumer-side exception never leaks staging threads.
+
+`depth=0` degenerates to a synchronous inline map on the consumer thread —
+the "prefetch off" mode bench.py measures against, and the debugging
+escape hatch.
+
+The `device_put` half of staging lives here (and in `parallel/bridge.py`)
+by design: scripts/lint.py forbids raw `jax.device_put` in the hot-loop
+modules, so every host->HBM transfer goes through one of these two files.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from mmlspark_tpu import config
+
+PREFETCH_DEPTH = config.register(
+    "MMLSPARK_TPU_PREFETCH_DEPTH", default=8, ptype=int,
+    doc="Default pipeline depth: staged batches in flight per hot loop "
+        "(TPUModel scoring window, image-decode lookahead). 0 disables "
+        "overlap (synchronous per-batch round trips).")
+
+PREFETCH_WORKERS = config.register(
+    "MMLSPARK_TPU_PREFETCH_WORKERS", default=4, ptype=int,
+    doc="Staging thread-pool width per prefetcher (clamped to the depth); "
+        "threads run host featurize/pad work and the device_put transfer.")
+
+
+def default_depth() -> int:
+    """The configured pipeline depth (MMLSPARK_TPU_PREFETCH_DEPTH)."""
+    return max(0, int(config.get("MMLSPARK_TPU_PREFETCH_DEPTH")))
+
+
+class Prefetcher:
+    """Order-preserving bounded background map over an item iterator.
+
+        with Prefetcher(stage_fn, plans, depth=8) as staged:
+            for result in staged:
+                consume(result)
+
+    `stage_fn(item)` runs on worker threads; iteration yields
+    `stage_fn(item)` for every item, in item order.
+    """
+
+    def __init__(self, fn: Callable[[Any], Any], items: Iterable,
+                 *, depth: int, workers: Optional[int] = None,
+                 name: str = "prefetch"):
+        self._closed = False  # first: __del__ runs even if init raises
+        if depth < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+        self._fn = fn
+        self._items = iter(items)
+        self._depth = int(depth)
+        if workers is None:
+            workers = int(config.get("MMLSPARK_TPU_PREFETCH_WORKERS"))
+        self._workers = max(1, min(int(workers), depth or 1))
+        self._name = name
+        self._pending: deque = deque()   # futures, submission order
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._source_error: Optional[BaseException] = None
+        self._exhausted = False
+
+    # -- iteration ------------------------------------------------------
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if self._depth == 0:
+            # synchronous mode: no threads, no lookahead — the item is
+            # pulled, staged, and returned on the consumer thread
+            try:
+                item = next(self._items)
+            except StopIteration:
+                self.close()
+                raise
+            return self._fn(item)
+        try:
+            self._top_up()
+            if not self._pending:
+                if self._source_error is not None:
+                    err, self._source_error = self._source_error, None
+                    raise err
+                self.close()
+                raise StopIteration
+            result = self._pending.popleft().result()
+            self._top_up()  # refill the window before handing control back
+            return result
+        except StopIteration:
+            raise
+        except BaseException:
+            self.close()
+            raise
+
+    def _top_up(self) -> None:
+        """Keep `depth` items staged; source errors are deferred until the
+        already-staged results have been delivered (ordering contract)."""
+        while (not self._exhausted and self._source_error is None
+                and len(self._pending) < self._depth):
+            try:
+                item = next(self._items)
+            except StopIteration:
+                self._exhausted = True
+                break
+            except BaseException as e:  # source iterator failed
+                self._source_error = e
+                break
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self._workers,
+                    thread_name_prefix=f"mmlspark-{self._name}")
+            self._pending.append(self._executor.submit(self._fn, item))
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self) -> None:
+        """Cancel queued work and release the pool (idempotent; safe even
+        when __init__ raised before the queues existed)."""
+        if self._closed or not hasattr(self, "_pending"):
+            return
+        self._closed = True
+        for fut in self._pending:
+            fut.cancel()
+        self._pending.clear()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        self.close()
+
+
+class OncePerTable:
+    """Thread-safe lazy computation shared by one table's staged batches.
+
+    The per-table host conversion (`TPUModel._tensor_column`'s np.stack)
+    must run ONCE even when several of the table's batches stage
+    concurrently on different workers; whichever worker arrives first pays
+    the cost and the rest reuse the value.
+    """
+
+    def __init__(self, compute: Callable[[], Any]):
+        self._compute = compute
+        self._lock = threading.Lock()
+        self._value = None
+        self._done = False
+
+    def get(self) -> Any:
+        if self._done:  # fast path: no lock once materialized
+            return self._value
+        with self._lock:
+            if not self._done:
+                self._value = self._compute()
+                self._done = True
+        return self._value

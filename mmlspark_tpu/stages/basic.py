@@ -94,7 +94,9 @@ class CheckpointData(Transformer):
     removeCheckpoint = Param(False, "release instead of persist", ptype=bool)
 
     def transform(self, table: DataTable) -> DataTable:
-        import jax
+        from mmlspark_tpu.parallel.bridge import shard_batch
+        from mmlspark_tpu.parallel.mesh import best_mesh
+
         out = table.select(*table.columns)
         if self.removeCheckpoint:
             # deliberate mutation of the input (the one exception to the
@@ -103,10 +105,18 @@ class CheckpointData(Transformer):
             table.__dict__.pop("_device_cache", None)
             return out
         cache: dict[str, object] = {}
+        # stage with the mesh BATCH sharding (not default single-device
+        # placement): TPUModel slices this cache per minibatch, and a
+        # default-placed column would silently reshard — a cross-device
+        # gather — on every batch.  With batch sharding the per-batch
+        # reshard is a no-op on the default mesh.  shard_batch pads rows
+        # to a data-axis multiple; consumers take valid counts from the
+        # HOST column length (the cache is layout, not truth).
+        mesh = best_mesh()
         for name in out.columns:
             arr = out[name]
             if arr.dtype != object and np.issubdtype(arr.dtype, np.number):
-                cache[name] = jax.device_put(np.ascontiguousarray(arr))
+                cache[name] = shard_batch(np.ascontiguousarray(arr), mesh)
         out.__dict__["_device_cache"] = cache
         return out
 

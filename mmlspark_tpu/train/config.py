@@ -55,6 +55,11 @@ class TrainerConfig:
     pipeline_stages: int = 1
     pipeline_microbatches: int = 4
 
+    # input pipeline: staged batches in flight (parallel/prefetch.py) —
+    # batch k+1's index/mask build and device_put overlap the jitted step
+    # k (double buffering); 0 = synchronous staging on the dispatch thread
+    prefetch_depth: int = 2
+
     # checkpoint/resume (the reference had none, SURVEY section 5)
     checkpoint_dir: Optional[str] = None
     checkpoint_every_steps: int = 0        # 0 = only at end

@@ -37,11 +37,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from mmlspark_tpu.models.bundle import ModelBundle, _to_plain
 from mmlspark_tpu.models.definitions import build_model
 from mmlspark_tpu.observe import MetricData, get_logger
+from mmlspark_tpu.observe.spans import active_timings, span_on
 from mmlspark_tpu.parallel.bridge import (gather_replicated, gather_to_host,
-                                          put_sharded)
+                                          put_like, put_sharded, put_tree)
 from mmlspark_tpu.parallel.distributed import (barrier, initialize_distributed,
                                                is_coordinator, run_collective)
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh, replicated
+from mmlspark_tpu.parallel.prefetch import Prefetcher
 from mmlspark_tpu.resilience.chaos import get_injector
 from mmlspark_tpu.resilience.checkpoints import (checkpoint_name,
                                                  latest_valid_checkpoint,
@@ -243,9 +245,10 @@ class Trainer:
                 path, jax.ShapeDtypeStruct(np.shape(leaf),
                                            np.asarray(leaf).dtype)),
             params)
-        params = jax.tree_util.tree_map(jax.device_put, params, shardings)
-        batch_stats = jax.tree_util.tree_map(
-            lambda leaf: jax.device_put(leaf, replicated(self.mesh)), batch_stats)
+        params = put_tree(params, shardings)
+        batch_stats = put_tree(
+            batch_stats, jax.tree_util.tree_map(
+                lambda _: replicated(self.mesh), batch_stats))
         # opt_state leaves mirror params; EAGER init follows each param
         # leaf's NamedSharding (a jitted init commits the fresh zeros to
         # one device instead, leaving a mixed-device state that a later
@@ -275,8 +278,7 @@ class Trainer:
                 jax.random.key(self.config.seed), vocab_size=m.vocab_size,
                 d_model=m.d_model, n_heads=m.n_heads, n_layers=m.n_layers,
                 max_len=m.max_len, mlp_ratio=m.mlp_ratio)
-        params = jax.device_put(
-            params, pipeline_param_shardings(self.mesh, params))
+        params = put_tree(params, pipeline_param_shardings(self.mesh, params))
         # eager init: opt_state shardings mirror the stage-sharded params
         # (see init_state — jitted init would commit to one device)
         opt_state = self._tx.init(params)
@@ -464,44 +466,102 @@ class Trainer:
         # the original numbering, skipping steps below `skip_until` —
         # the epoch/batch order is identical, so the resumed run feeds
         # exactly the batches the preempted one never saw.
-        step = base_step
         chaos = get_injector()
         self._rows_seen = np.zeros(n_local, bool)  # coverage, inspectable
-        with PreemptionGuard(install=bool(ckpt_dir)) as guard:
+        # double-buffered staging (config.prefetch_depth, default 2): while
+        # the jitted step k runs, the staging thread builds step k+1's
+        # index/mask arrays and starts their device_put — the transfer
+        # overlaps compute instead of alternating with it.  Numerics are
+        # untouched: the plan below yields exactly the (epoch, step, batch)
+        # sequence the serial loop fed, and rng consumption order is
+        # identical (orders are drawn epoch-by-epoch on the consumer
+        # thread as the prefetcher tops up).
+        depth = max(0, int(getattr(cfg, "prefetch_depth", 2)))
+        timings = active_timings()  # captured: workers have no context
+
+        def plan():
+            step_c = base_step
             for epoch in range(cfg.epochs):
                 order = _epoch_order(rng, epoch, n, n_local,
                                      cfg.shuffle_each_epoch)
                 self._rows_seen[order] = True
-                losses: list = []
-                step_metrics: list = []
                 for start in range(0, n, bs_local):
-                    if step < skip_until:  # completed before preemption
-                        step += 1
+                    if step_c < skip_until:  # completed before preemption
+                        step_c += 1
                         continue
-                    chaos.on_step(step)  # may deliver the simulated SIGTERM
-                    idx = order[start:start + bs_local]
-                    valid = len(idx)
-                    if valid < bs_local:
-                        # cycle real rows into the pad (see module docstring)
-                        idx = np.concatenate([idx,
-                                              np.resize(order,
-                                                        bs_local - valid)])
-                    mask = np.zeros(bs_local, np.float32)
-                    mask[:valid] = 1.0
-                    xb = put_sharded(x[idx], x_sh)
-                    yb = put_sharded(y[idx], x_sh)
-                    mask_d = put_sharded(mask, x_sh)
-                    state, loss, metrics = step_fn(state, xb, yb, mask_d)
+                    yield (epoch, step_c, order, start)
+                    step_c += 1
+
+        def stage(item):
+            epoch, step_c, order, start = item
+            with span_on(timings, "host"):
+                idx = order[start:start + bs_local]
+                valid = len(idx)
+                if valid < bs_local:
+                    # cycle real rows into the pad (see module docstring)
+                    idx = np.concatenate([idx,
+                                          np.resize(order,
+                                                    bs_local - valid)])
+                mask = np.zeros(bs_local, np.float32)
+                mask[:valid] = 1.0
+                xh, yh = x[idx], y[idx]
+            with span_on(timings, "transfer"):
+                xb = put_sharded(xh, x_sh)
+                yb = put_sharded(yh, x_sh)
+                mask_d = put_sharded(mask, x_sh)
+            return epoch, step_c, xb, yb, mask_d
+
+        losses: list = []
+        step_metrics: list = []
+        cur_epoch: Optional[int] = None
+
+        def finish_epoch():
+            # one history row per epoch that executed at least one step
+            # (epochs fully skipped by resume produce no staged items)
+            if cur_epoch is None or not losses:
+                return
+            n_batches = len(losses)
+            epoch_loss = float(np.sum(jax.device_get(losses)))
+            rec = {"epoch": cur_epoch,
+                   "loss": epoch_loss / max(n_batches, 1),
+                   "wall_s": time.perf_counter() - t0}
+            if step_metrics:
+                # model-sown diagnostics (e.g. MoE overflow fraction)
+                # averaged over the epoch's steps, one history column each
+                fetched = jax.device_get(step_metrics)
+                for key in fetched[0]:
+                    rec[key] = float(np.mean([m[key] for m in fetched]))
+            self.history.append(rec)
+            emit = log_fn if log_fn is not None \
+                else get_logger("train").info
+            if cur_epoch % max(1, log_every) == 0 \
+                    or cur_epoch == cfg.epochs - 1:
+                emit(f"epoch {cur_epoch}: loss={rec['loss']:.5f} "
+                     f"({rec['wall_s']:.1f}s)")
+
+        staged = Prefetcher(stage, plan(), depth=depth, name="train")
+        with PreemptionGuard(install=bool(ckpt_dir)) as guard:
+            try:
+                for epoch, step_c, xb, yb, mask_d in staged:
+                    if epoch != cur_epoch:
+                        finish_epoch()
+                        cur_epoch = epoch
+                        losses, step_metrics = [], []
+                    chaos.on_step(step_c)  # may deliver simulated SIGTERM
+                    with span_on(timings, "compute"):
+                        state, loss, metrics = step_fn(state, xb, yb, mask_d)
                     losses.append(loss)  # device array; fetched at epoch end
                     if metrics:
                         step_metrics.append(metrics)
-                    step += 1
+                    step = step_c + 1
                     if ckpt_dir and cfg.checkpoint_every_steps and \
                             step % cfg.checkpoint_every_steps == 0:
                         self.save_checkpoint(state, ckpt_dir)
                     # the in-flight step finished; honor a pending SIGTERM
                     # at the step boundary (lockstep under multi-host:
-                    # every process must agree before the collective save)
+                    # every process must agree before the collective save).
+                    # The already-staged next batch is simply discarded —
+                    # Prefetcher.close() below cancels the staging pool.
                     preempt_now = guard.triggered
                     if nproc > 1:
                         from jax.experimental import multihost_utils
@@ -514,24 +574,9 @@ class Trainer:
                         self.save_checkpoint(state, ckpt_dir)
                         self._last_state = state
                         raise Preempted(step=step, ckpt_dir=ckpt_dir)
-                if not losses:
-                    continue  # epoch fully skipped by resume: no history row
-                n_batches = len(losses)
-                epoch_loss = float(np.sum(jax.device_get(losses)))
-                rec = {"epoch": epoch, "loss": epoch_loss / max(n_batches, 1),
-                       "wall_s": time.perf_counter() - t0}
-                if step_metrics:
-                    # model-sown diagnostics (e.g. MoE overflow fraction)
-                    # averaged over the epoch's steps, one history column each
-                    fetched = jax.device_get(step_metrics)
-                    for key in fetched[0]:
-                        rec[key] = float(np.mean([m[key] for m in fetched]))
-                self.history.append(rec)
-                emit = log_fn if log_fn is not None \
-                    else get_logger("train").info
-                if epoch % max(1, log_every) == 0 or epoch == cfg.epochs - 1:
-                    emit(f"epoch {epoch}: loss={rec['loss']:.5f} "
-                         f"({rec['wall_s']:.1f}s)")
+                finish_epoch()
+            finally:
+                staged.close()
         if ckpt_dir:
             self.save_checkpoint(state, ckpt_dir)
         # the run's loss curve through the typed contract (Metrics.scala:37-47)
@@ -636,13 +681,13 @@ class Trainer:
                     f"no valid checkpoint in {ckpt_dir}")
             with open(path, "rb") as f:
                 restored = serialization.from_bytes(template, f.read())
-        put = lambda new, old: jax.device_put(new, old.sharding) \
-            if hasattr(old, "sharding") else new
         return TrainState(
             step=jnp.asarray(restored["step"]),
-            params=jax.tree_util.tree_map(put, restored["params"], state.params),
-            opt_state=jax.tree_util.tree_map(put, restored["opt_state"],
+            params=jax.tree_util.tree_map(put_like, restored["params"],
+                                          state.params),
+            opt_state=jax.tree_util.tree_map(put_like, restored["opt_state"],
                                              state.opt_state),
-            batch_stats=jax.tree_util.tree_map(put, restored["batch_stats"],
+            batch_stats=jax.tree_util.tree_map(put_like,
+                                               restored["batch_stats"],
                                                state.batch_stats),
         )

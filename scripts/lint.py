@@ -11,6 +11,12 @@ Checks, per file:
   * direct `urllib.request.urlopen` calls outside `mmlspark_tpu/resilience/`
     — raw network I/O must go through the policy layer (retry/backoff,
     circuit breaker, chaos hooks in `resilience/net.py`), never around it
+  * raw `jax.device_put` in the hot-loop modules (scoring/training/staging
+    data paths) — host->HBM transfers there must go through
+    `parallel/bridge.py` (put_sharded/shard_batch/put_tree/reshard) or the
+    `parallel/prefetch.py` staging pipeline, so every transfer is sharded
+    deliberately and visible to the stage-timing spans; a bare device_put
+    silently commits to one device and de-pipelines the loop
   * tabs in indentation
 """
 
@@ -27,9 +33,29 @@ ROOTS = ["mmlspark_tpu", "tests", "examples", "scripts",
 # the policy layer everything else is required to go through
 RESILIENCE_DIR = os.path.join("mmlspark_tpu", "resilience")
 
+# hot-loop modules: per-batch scoring/training/staging data paths where a
+# raw jax.device_put bypasses the bridge/prefetch transfer layer
+HOT_LOOP_FILES = {
+    os.path.join("mmlspark_tpu", "models", "tpu_model.py"),
+    os.path.join("mmlspark_tpu", "train", "trainer.py"),
+    os.path.join("mmlspark_tpu", "train", "learner.py"),
+    os.path.join("mmlspark_tpu", "stages", "basic.py"),
+    os.path.join("mmlspark_tpu", "io", "image_reader.py"),
+    os.path.join("mmlspark_tpu", "io", "files.py"),
+}
+
 
 def _in_resilience(path: str) -> bool:
     return os.path.normpath(path).startswith(RESILIENCE_DIR + os.sep)
+
+
+def _is_device_put_call(node: ast.Call) -> bool:
+    """Matches `jax.device_put(...)` and a bare `device_put(...)` from
+    `from jax import device_put` (any attribute chain ending .device_put)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "device_put"
+    return isinstance(fn, ast.Attribute) and fn.attr == "device_put"
 
 
 def _is_urlopen_call(node: ast.Call) -> bool:
@@ -92,6 +118,7 @@ def check_file(path: str) -> list[str]:
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
 
     in_resilience = _in_resilience(path)
+    in_hot_loop = os.path.normpath(path) in HOT_LOOP_FILES
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None \
                 and not in_resilience:
@@ -102,6 +129,13 @@ def check_file(path: str) -> list[str]:
                 f"{path}:{node.lineno}: direct urllib.request.urlopen — "
                 f"use the resilience policy layer "
                 f"(mmlspark_tpu.resilience.net.fetch_url/http_get)")
+        if isinstance(node, ast.Call) and in_hot_loop \
+                and _is_device_put_call(node):
+            problems.append(
+                f"{path}:{node.lineno}: raw jax.device_put in a hot-loop "
+                f"module — transfers go through parallel/bridge.py "
+                f"(put_sharded/shard_batch/put_tree/reshard) or "
+                f"parallel/prefetch.py staging")
 
     if os.path.basename(path) != "__init__.py":
         used = used_names(tree)

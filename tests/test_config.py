@@ -65,3 +65,29 @@ def test_every_env_read_goes_through_registry():
             for m in re.finditer(r"os\.environ[^\n]*MMLSPARK_TPU_", src):
                 offenders.append((path, m.group(0)))
     assert not offenders, offenders
+
+
+def test_prefetch_vars_registered():
+    import mmlspark_tpu.parallel.prefetch  # noqa: F401  (registers on import)
+    names = {d["name"] for d in config.describe()}
+    assert {"MMLSPARK_TPU_PREFETCH_DEPTH", "MMLSPARK_TPU_PREFETCH_WORKERS",
+            "MMLSPARK_TPU_COMPILATION_CACHE"} <= names
+    assert config.get("MMLSPARK_TPU_PREFETCH_DEPTH") == 8
+
+
+def test_compilation_cache_wiring(tmp_path):
+    """setup_compilation_cache points JAX's persistent XLA cache at the
+    configured directory (warm restarts skip recompiles); unset = no-op."""
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    assert config.setup_compilation_cache() is None  # unset: untouched
+    cache_dir = str(tmp_path / "xla-cache")
+    config.set("MMLSPARK_TPU_COMPILATION_CACHE", cache_dir)
+    try:
+        effective = config.setup_compilation_cache()
+        assert effective == cache_dir
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+    finally:
+        config.set("MMLSPARK_TPU_COMPILATION_CACHE", None)
+        jax.config.update("jax_compilation_cache_dir", prev)

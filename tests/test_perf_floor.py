@@ -81,6 +81,16 @@ def test_bench_contract_fields():
     assert result["value"] > 0 and result["device_images_per_sec"] > 0
     link = bench.probe_link_mbps()
     assert {"link_h2d_MBps", "link_d2h_MBps"} <= set(link)
+    # stage-attributed pipeline timing (docs/performance.md): bench --smoke
+    # must emit the prefetch on/off comparison and the per-stage breakdown
+    assert {"prefetch_images_per_sec", "no_prefetch_images_per_sec",
+            "prefetch_speedup", "stage_host_s", "stage_transfer_s",
+            "stage_compute_s", "stage_drain_s", "bottleneck"} <= set(result)
+    assert result["prefetch_images_per_sec"] > 0
+    assert result["no_prefetch_images_per_sec"] > 0
+    assert result["bottleneck"] in ("host", "transfer", "compute", "drain")
+    # thread-seconds accounting: the pipelined run did attribute real time
+    assert result["stage_compute_s"] > 0 and result["stage_drain_s"] >= 0
 
 
 @pytest.mark.skipif(not on_tpu, reason="MFU floor needs a real TPU chip")

@@ -1,0 +1,365 @@
+"""Prefetcher contract tests: ordering, backpressure, exception and
+preemption propagation — all deterministic (event-based synchronization,
+no sleeps: every wait is on a threading.Event another thread must set).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataTable, config, pipeline_timing
+from mmlspark_tpu.parallel.prefetch import OncePerTable, Prefetcher
+
+
+# -- ordering ----------------------------------------------------------------
+
+def test_results_in_item_order_fast_path():
+    pf = Prefetcher(lambda i: i * 2, range(10), depth=3)
+    assert list(pf) == [i * 2 for i in range(10)]
+
+
+def test_order_preserved_when_later_items_finish_first():
+    """Workers complete in REVERSE order (gated one by one); the consumer
+    must still receive results in submission order."""
+    n = 4
+    gates = [threading.Event() for _ in range(n)]
+    done = [threading.Event() for _ in range(n)]
+    finish_order: list = []
+
+    def fn(i):
+        gates[i].wait()
+        finish_order.append(i)
+        done[i].set()
+        return i
+
+    results: list = []
+    pf = Prefetcher(fn, range(n), depth=n, workers=n)
+    consumer = threading.Thread(target=lambda: results.extend(pf))
+    consumer.start()
+    # release item gates newest-first, waiting for each completion so the
+    # recorded finish order is exactly the reverse of submission order
+    for i in reversed(range(n)):
+        gates[i].set()
+        done[i].wait()
+    consumer.join()
+    assert finish_order == [3, 2, 1, 0]
+    assert results == [0, 1, 2, 3]
+
+
+def test_result_not_delivered_before_predecessor():
+    """Even with item 1 finished, its result must wait for item 0."""
+    gate0 = threading.Event()
+    done1 = threading.Event()
+    delivered: list = []
+    first_delivery = threading.Event()
+
+    def fn(i):
+        if i == 0:
+            gate0.wait()
+        else:
+            done1.set()
+        return i
+
+    pf = Prefetcher(fn, range(2), depth=2, workers=2)
+
+    def consume():
+        for r in pf:
+            delivered.append(r)
+            first_delivery.set()
+
+    consumer = threading.Thread(target=consume)
+    consumer.start()
+    done1.wait()              # item 1 has completed on its worker
+    assert delivered == []    # guaranteed: consumer is blocked on item 0
+    gate0.set()
+    consumer.join()
+    assert delivered == [0, 1]
+    assert first_delivery.is_set()
+
+
+# -- backpressure ------------------------------------------------------------
+
+def test_source_never_advanced_past_depth_lookahead():
+    """The item iterator is pulled at most `depth` items beyond what the
+    consumer has taken (bounded lookahead = bounded residency)."""
+    pulled = 0
+
+    def items():
+        nonlocal pulled
+        for i in range(100):
+            pulled += 1
+            yield i
+
+    depth = 3
+    pf = Prefetcher(lambda i: i, items(), depth=depth, workers=2)
+    it = iter(pf)
+    taken = [next(it) for _ in range(5)]
+    assert taken == list(range(5))
+    # pulls happen only on the consumer thread (during next()), so this
+    # bound is exact, not racy
+    assert pulled <= 5 + depth
+    pf.close()
+
+
+def test_never_more_than_depth_items_staged():
+    """Peak concurrently-staged items <= depth + 1: the staging window
+    holds `depth` batches, plus at most the one batch currently in the
+    consumer's hands (the window refills as soon as a result is handed
+    over, so workers stay busy while the consumer computes)."""
+    lock = threading.Lock()
+    staged = 0
+    peak = 0
+
+    def fn(i):
+        nonlocal staged, peak
+        with lock:
+            staged += 1
+            peak = max(peak, staged)
+        return i
+
+    depth = 3
+    pf = Prefetcher(fn, range(50), depth=depth, workers=8)
+    for r in pf:
+        with lock:
+            staged -= 1
+    assert peak <= depth + 1
+
+
+# -- exception propagation ---------------------------------------------------
+
+def test_stage_exception_surfaces_at_its_position():
+    def fn(i):
+        if i == 2:
+            raise ValueError("boom at 2")
+        return i
+
+    pf = Prefetcher(fn, range(6), depth=4, workers=4)
+    it = iter(pf)
+    assert next(it) == 0
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="boom at 2"):
+        next(it)
+    # the failed prefetcher is closed: iteration is over, not wedged
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_source_exception_after_staged_results_delivered():
+    """An items-iterator failure surfaces only after every already-staged
+    result reaches the consumer (ordering contract holds to the end)."""
+    def items():
+        yield 0
+        yield 1
+        raise RuntimeError("source died")
+
+    pf = Prefetcher(lambda i: i, items(), depth=2, workers=2)
+    it = iter(pf)
+    assert next(it) == 0
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="source died"):
+        next(it)
+
+
+def test_close_with_blocked_workers_does_not_wedge():
+    gate = threading.Event()
+    started = threading.Event()
+
+    def fn(i):
+        if i == 0:
+            return i
+        started.set()
+        gate.wait()
+        return i
+
+    pf = Prefetcher(fn, range(5), depth=3, workers=2)
+    it = iter(pf)
+    assert next(it) == 0
+    started.wait()    # a worker is now parked on the gate
+    pf.close()        # must return without joining the blocked worker
+    gate.set()        # release the thread so the process exits cleanly
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+# -- synchronous mode --------------------------------------------------------
+
+def test_depth_zero_runs_inline_on_consumer_thread():
+    me = threading.get_ident()
+    pf = Prefetcher(lambda i: (i, threading.get_ident()), range(4), depth=0)
+    for i, ident in pf:
+        assert ident == me
+
+
+def test_negative_depth_rejected():
+    with pytest.raises(ValueError):
+        Prefetcher(lambda i: i, range(3), depth=-1)
+
+
+def test_once_per_table_computes_once_across_threads():
+    calls = []
+    box = OncePerTable(lambda: calls.append(1) or "value")
+    results = []
+    threads = [threading.Thread(target=lambda: results.append(box.get()))
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == ["value"] * 8
+    assert len(calls) == 1
+
+
+# -- TPUModel wiring ---------------------------------------------------------
+
+def _convnet_model(**kwargs):
+    from mmlspark_tpu.models import ConvNetCIFAR10, ModelBundle, TPUModel
+    bundle = ModelBundle.init(ConvNetCIFAR10(), (1, 32, 32, 3), seed=0)
+    return TPUModel(bundle, inputCol="image", outputCol="scores",
+                    miniBatchSize=64, **kwargs)
+
+
+@pytest.fixture(scope="module")
+def image_table():
+    rng = np.random.default_rng(0)
+    return DataTable({
+        "image": rng.integers(0, 256, size=(200, 32, 32, 3), dtype=np.uint8)})
+
+
+def test_transform_prefetch_on_off_identical(image_table):
+    on = _convnet_model().transform(image_table)
+    off = _convnet_model(prefetchDepth=0).transform(image_table)
+    np.testing.assert_allclose(np.asarray(on["scores"]),
+                               np.asarray(off["scores"]), atol=1e-6)
+
+
+def test_prefetch_depth_param_defaults_to_config(image_table):
+    model = _convnet_model()
+    assert model._prefetch_depth() == config.get("MMLSPARK_TPU_PREFETCH_DEPTH")
+    config.set("MMLSPARK_TPU_PREFETCH_DEPTH", 3)
+    try:
+        assert model._prefetch_depth() == 3
+        assert model.copy(prefetchDepth=1)._prefetch_depth() == 1
+    finally:
+        config.set("MMLSPARK_TPU_PREFETCH_DEPTH", None)
+
+
+def test_transform_batches_order_with_interleaved_empty_tables(image_table):
+    rng = np.random.default_rng(1)
+    tables = [
+        image_table.take(70),
+        DataTable({"image": np.zeros((0, 32, 32, 3), np.uint8)}),
+        DataTable({"image": rng.integers(0, 256, (130, 32, 32, 3),
+                                         dtype=np.uint8)}),
+    ]
+    model = _convnet_model(prefetchDepth=2)
+    scored = list(model.transform_batches(iter(tables)))
+    assert [t.num_rows for t in scored] == [70, 0, 130]
+    ref = _convnet_model(prefetchDepth=0)
+    for got, table in zip(scored, tables):
+        want = ref.transform(table)
+        np.testing.assert_allclose(np.asarray(got["scores"]),
+                                   np.asarray(want["scores"]), atol=1e-6)
+
+
+def test_pipeline_timing_attributes_stages(image_table):
+    model = _convnet_model()
+    with pipeline_timing() as spans:
+        model.transform(image_table)
+    summary = spans.summary()
+    assert summary["stage_compute_s"] > 0
+    assert summary["stage_drain_s"] > 0
+    # host stacking + transfer ran on staging threads and were recorded
+    # there (collectors pass by capture, not contextvar inheritance)
+    assert spans.counts.get("host", 0) > 0
+    assert spans.counts.get("transfer", 0) > 0
+    assert summary["bottleneck"] in ("host", "transfer", "compute", "drain")
+
+
+def test_device_cache_path_valid_counts_with_padded_cache():
+    """CheckpointData now pads the cached column to a data-axis multiple;
+    scoring through the cache must still emit exactly num_rows outputs,
+    identical to the uncached path."""
+    from mmlspark_tpu.stages.basic import CheckpointData
+    rng = np.random.default_rng(2)
+    # 70 rows: not a multiple of the 8-device data axis NOR of the batch
+    table = DataTable({
+        "image": rng.integers(0, 256, (70, 32, 32, 3), dtype=np.uint8)
+        .astype(np.float32)})
+    staged = CheckpointData().transform(table)
+    cache = CheckpointData.get_device_cache(staged)
+    assert cache["image"].shape[0] % 8 == 0  # padded for the mesh
+    model = _convnet_model()
+    got = model.transform(staged)
+    assert got["scores"].shape[0] == 70
+    want = _convnet_model(prefetchDepth=0).transform(table)
+    np.testing.assert_allclose(np.asarray(got["scores"]),
+                               np.asarray(want["scores"]), atol=1e-5)
+
+
+# -- trainer wiring: preemption during prefetch ------------------------------
+
+def test_preemption_during_prefetch_writes_emergency_checkpoint(tmp_path):
+    """SIGTERM (chaos-injected) landing while the NEXT batch is already
+    staged must still finish the in-flight step, write the emergency
+    checkpoint, and raise Preempted — and the resumed run must match the
+    fault-free one exactly (staged-but-unconsumed batches are discarded,
+    never half-applied)."""
+    from mmlspark_tpu.resilience import Preempted, reset_chaos
+    from mmlspark_tpu.resilience.checkpoints import latest_valid_checkpoint
+    from mmlspark_tpu.train import Trainer, TrainerConfig
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    cfg = TrainerConfig(
+        architecture="MLPClassifier",
+        model_config={"hidden_sizes": [8], "num_classes": 2,
+                      "dtype": "float32"},
+        epochs=4, batch_size=64, shuffle_each_epoch=False,
+        prefetch_depth=2, learning_rate=0.1)
+    ref_trainer = Trainer(cfg)
+    ref = ref_trainer.fit_arrays(x, y)
+    assert ref.metadata["steps"] == 8
+
+    ckpt = str(tmp_path / "ckpt")
+    config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", 3)
+    reset_chaos()
+    try:
+        with pytest.raises(Preempted) as ei:
+            Trainer(cfg).fit_arrays(x, y, ckpt_dir=ckpt, resume=True)
+        assert ei.value.step == 4  # the in-flight step finished first
+    finally:
+        config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", None)
+        reset_chaos()
+    assert latest_valid_checkpoint(ckpt) is not None
+
+    resumed = Trainer(cfg).fit_arrays(x, y, ckpt_dir=ckpt, resume=True)
+    assert resumed.metadata["steps"] == ref.metadata["steps"]
+    np.testing.assert_allclose(
+        np.asarray(resumed.variables["params"]["dense0"]["kernel"]),
+        np.asarray(ref.variables["params"]["dense0"]["kernel"]), atol=1e-6)
+
+
+def test_trainer_prefetch_depth_zero_matches_default():
+    """Double buffering must not change numerics: depth 0 (serial staging)
+    and depth 2 produce identical weights."""
+    from mmlspark_tpu.train import Trainer, TrainerConfig
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(96, 4)).astype(np.float32)
+    y = (x[:, 1] > 0).astype(np.int32)
+
+    def fit(depth):
+        cfg = TrainerConfig(
+            architecture="MLPClassifier",
+            model_config={"hidden_sizes": [8], "num_classes": 2,
+                          "dtype": "float32"},
+            epochs=3, batch_size=32, shuffle_each_epoch=True,
+            prefetch_depth=depth)
+        return Trainer(cfg).fit_arrays(x, y)
+
+    a, b = fit(0), fit(2)
+    np.testing.assert_allclose(
+        np.asarray(a.variables["params"]["dense0"]["kernel"]),
+        np.asarray(b.variables["params"]["dense0"]["kernel"]), atol=1e-7)

@@ -447,26 +447,48 @@ def bench_lm_train(smoke: bool, long_context: bool = False) -> dict:
 
 
 def bench_lm_decode(smoke: bool) -> dict:
-    """Autoregressive decode throughput (models/generate.py): the jit-once
-    KV-cache program.  Two generation lengths are timed and DIFFERENCED so
-    the reported rate is the steady per-step decode cost — prefill and any
-    constant dispatch overhead cancel out of the subtraction."""
+    """Autoregressive decode throughput (models/generate.py).  Three arms:
+
+    1. FULL-CACHE steady step (the original jit-once per-length program):
+       two generation lengths timed and DIFFERENCED so the reported rate
+       is the steady per-step decode cost — prefill and constant dispatch
+       overhead cancel out.  Every step reads all max_len cache slots.
+    2. WINDOWED steady step (DecodeEngine) at ~25% cache occupancy: same
+       differencing, but the compiled segment attends only over the
+       chunk-rounded cache prefix — the occupancy-scaling claim, measured.
+    3. RAGGED workload (TextGenerator.transform): >= 8 distinct prompt
+       lengths through the bucketed engine — compiled-program count (was
+       one per length), tokens/sec, and prefill/decode span attribution.
+    """
     import jax
     import jax.numpy as jnp
 
+    from mmlspark_tpu import DataTable, pipeline_timing
+    from mmlspark_tpu.models import ModelBundle, TextGenerator
     from mmlspark_tpu.models.definitions import build_model
-    from mmlspark_tpu.models.generate import make_generate_fn
+    from mmlspark_tpu.models.generate import (DecodeEngine, _round_up,
+                                              make_generate_fn)
 
     if smoke:
         b, p_len, n1, n2, cfg = 2, 16, 4, 12, {
             "vocab_size": 256, "d_model": 64, "n_heads": 4, "n_layers": 2,
             "max_len": 64}
         reps = 1
+        # windowed arm: bucket 8 + chunk 16 -> a 16-slot window, 25% of
+        # the 64-slot max_len cache the full-cache arm reads every step
+        chunk, p_lo, w_n1, w_n2 = 16, 8, 2, 8
+        # ragged arm: 8 lengths in exactly two buckets (16 and 32)
+        ragged_lengths, ragged_rows, ragged_new = \
+            [9, 10, 11, 12, 17, 18, 19, 20], 1, 8
     else:
         b, p_len, n1, n2, cfg = 16, 128, 64, 320, {
             "vocab_size": 8192, "d_model": 1024, "n_heads": 8,
             "n_layers": 4, "max_len": 512}
         reps = 3
+        # bucket 64 + chunk 128 -> a 128-slot window, 25% of max_len 512
+        chunk, p_lo, w_n1, w_n2 = 128, 64, 16, 64
+        ragged_lengths, ragged_rows, ragged_new = \
+            [41, 42, 43, 44, 73, 74, 75, 76], 2, 32
     model = build_model("TransformerLM", cfg)
     variables = jax.device_put(model.init(
         jax.random.key(0), np.zeros((1, p_len), np.int32)))
@@ -497,6 +519,54 @@ def bench_lm_decode(smoke: bool) -> dict:
         # report the whole-program rate of the longer run instead
         decode_tps = b * n2 / walls[n2]
         step_ms = walls[n2] / n2 * 1e3
+
+    # -- arm 2: windowed steady step at ~25% occupancy ------------------
+    # same batch and weights; the engine's segments for this bucket all
+    # fit one window, so every differenced step reads `window` slots
+    # where the full-cache arm reads max_len
+    window = _round_up(p_lo + 1, chunk)
+    w_prompts = np.asarray(
+        rng.integers(0, cfg["vocab_size"], (b, p_lo)), np.int32)
+    w_true = np.full(b, p_lo, np.int32)
+    w_walls = {}
+    for n_new in (w_n1, w_n2):
+        eng = DecodeEngine(model, n_new, chunk=chunk)
+        eng.generate(variables, w_prompts, w_true)  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            got = eng.generate(variables, w_prompts, w_true)
+            int(got[0, -1])  # generate() already fetched to host
+            best = min(best, time.perf_counter() - t0)
+        w_walls[n_new] = best
+    w_delta = w_walls[w_n2] - w_walls[w_n1]
+    if w_delta > 0:
+        windowed_step_ms = w_delta / (w_n2 - w_n1) * 1e3
+    else:
+        windowed_step_ms = w_walls[w_n2] / w_n2 * 1e3
+
+    # -- arm 3: ragged workload through the bucketed engine -------------
+    rag_rows = np.empty(len(ragged_lengths) * ragged_rows, object)
+    k = 0
+    for plen in ragged_lengths:
+        for r in range(ragged_rows):
+            rag_rows[k] = rng.integers(
+                0, cfg["vocab_size"], (plen,)).astype(np.int32)
+            k += 1
+    rag_table = DataTable({"prompt": rag_rows})
+    gen = TextGenerator(ModelBundle.from_module(model, variables),
+                        inputCol="prompt", outputCol="out",
+                        maxNewTokens=ragged_new, cacheChunk=chunk)
+    gen.transform(rag_table)  # compile every bucket's programs + warm
+    engine = gen._engine_for()
+    rag_programs = engine.compiled_programs
+    with pipeline_timing() as spans:
+        t0 = time.perf_counter()
+        gen.transform(rag_table)
+        rag_wall = time.perf_counter() - t0
+    rag_tokens = len(rag_rows) * ragged_new
+    span_summary = spans.summary()
+
     return {
         "metric": "transformer_lm_decode_tokens_per_sec_per_chip",
         "value": round(decode_tps, 1),
@@ -506,6 +576,21 @@ def bench_lm_decode(smoke: bool) -> dict:
         "prompt_len": p_len,
         "steady_step_ms": round(step_ms, 3),
         "d_model": cfg["d_model"],
+        # occupancy comparison: the same steady step at ~25% cache
+        # occupancy (windowed engine) vs the full-max_len read above
+        "full_cache_step_ms": round(step_ms, 3),
+        "full_cache_slots": cfg["max_len"],
+        "windowed_step_ms": round(windowed_step_ms, 3),
+        "window_slots": window,
+        "window_occupancy": round(window / cfg["max_len"], 3),
+        "windowed_vs_full_speedup": round(step_ms / windowed_step_ms, 3)
+        if windowed_step_ms > 0 else None,
+        # ragged workload: shape-class consolidation, measured
+        "ragged_distinct_lengths": len(ragged_lengths),
+        "ragged_compiled_programs": rag_programs,
+        "ragged_tokens_per_sec": round(rag_tokens / rag_wall, 1),
+        "stage_prefill_s": span_summary.get("stage_prefill_s", 0.0),
+        "stage_decode_s": span_summary.get("stage_decode_s", 0.0),
     }
 
 

@@ -61,9 +61,9 @@ def main(verbose: bool = True) -> dict:
     final_loss = trainer.history[-1]["loss"]
     log(f"trained: epoch-{len(trainer.history) - 1} loss {final_loss:.4f}")
 
-    # generate continuations with the KV-cache program: a TextGenerator
-    # stage over a table of prompts (each prompt length is one compiled
-    # shape class)
+    # generate continuations with the KV-cache decode engine: a
+    # TextGenerator stage over a table of prompts (prompts are bucketed —
+    # a handful of compiled shape classes serve any mix of lengths)
     prompts = tokens[:4, :PROMPT_LEN]
     gen = TextGenerator(bundle, inputCol="prompt", outputCol="generated",
                         maxNewTokens=MAX_NEW)

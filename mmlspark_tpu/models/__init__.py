@@ -7,7 +7,8 @@ from mmlspark_tpu.models.definitions import (
     build_model,
 )
 from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
-from mmlspark_tpu.models.generate import (TextGenerator, beam_search,
-                                          generate, make_beam_search_fn,
+from mmlspark_tpu.models.generate import (DecodeEngine, TextGenerator,
+                                          beam_search, generate,
+                                          make_beam_search_fn,
                                           make_generate_fn, naive_generate)
 from mmlspark_tpu.models.tpu_model import TPUModel

@@ -22,6 +22,36 @@ recipe:
     categorical over the top-k / top-p (nucleus) filtered distribution,
     decided at trace time (`filter_logits`).
 
+On top of that per-length recipe sits the **decode engine**
+(`DecodeEngine`): the serving path `TextGenerator` actually runs.  Three
+compounding optimisations over the one-program-per-prompt-length design:
+
+  * **length-bucketed prefill** — prompts are right-padded to a small set
+    of buckets (next power of two, floored at `DEFAULT_MIN_BUCKET`), with
+    per-row true-length position ids, attention visibility masks, and a
+    per-row last-logit gather, so a ragged workload collapses from one
+    compiled program *and one tiny batch per distinct length* into a
+    handful of shared shape classes scoring full batches.
+  * **cache-windowed decode** — generation runs in segments whose
+    compiled scan attends only over a cache *prefix* rounded up to a
+    chunk (`decode_segments`); the window grows as the write position
+    crosses chunk boundaries, so steady-step bandwidth scales with cache
+    occupancy instead of max_len.  Segment programs take the bucket and
+    step offsets as traced scalars, so buckets whose windows coincide
+    share one compiled segment.
+  * **stop-token early exit** — a per-row done mask rides the scan (done
+    rows freeze on their stop token) and the engine host-checks `done`
+    between segments, so a batch whose rows have all stopped skips the
+    remaining segments instead of always paying max_new_tokens steps.
+
+Greedy tokens are exactly those of the per-length decoder (test-pinned
+across bucket/window configurations): padding holes are masked to exact
+zero weight and positions are per-row, so bucketing is pure layout.
+Sampling keys fold in a stable per-row id — a row's draws depend only on
+(seed, row id, step), never on how rows were grouped or batched.  Beam
+search stays on the full-cache per-length path (windowing lands
+sampler-first; see docs/performance.md).
+
 The decoder re-implements the TransformerLM block math as pure functions
 over the SAME flax param tree (models/definitions.py names: qkv / proj /
 mlp_up / mlp_down / LayerNorm_0/1), so any trained TransformerLM bundle —
@@ -49,6 +79,7 @@ from jax import lax
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.models.bundle import load_bundle, save_bundle
+from mmlspark_tpu.observe.spans import active_timings, span_on
 
 NEG_INF = -1e30
 
@@ -385,18 +416,345 @@ def beam_search(module, variables, prompts, max_new_tokens: int,
     return np.asarray(tokens), np.asarray(scores)
 
 
+# ---------------------------------------------------------------------------
+# The decode engine: bucketed prefill + cache-windowed segments + early exit
+# ---------------------------------------------------------------------------
+
+DEFAULT_CACHE_CHUNK = 128  # cache-window growth granularity (slots): the
+# compiled decode step attends over the cache prefix rounded up to this,
+# so steady-step bandwidth tracks occupancy in chunk-sized increments
+DEFAULT_MIN_BUCKET = 8     # smallest prompt bucket: below this, shape-class
+# consolidation saves more than the pad compute costs
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+def bucket_length(n: int, max_len: int, max_new_tokens: int,
+                  min_bucket: int = DEFAULT_MIN_BUCKET) -> int:
+    """The prompt bucket for a true length `n`: next power of two, floored
+    at `min_bucket` and capped at `max_len - max_new_tokens` (the cap keeps
+    every bucket decodable to the full generation budget; position
+    embeddings are indexed by TRUE per-row positions, so the cap — not the
+    bucket's pad tail — is what the position table bounds)."""
+    cap = max_len - max_new_tokens
+    if n < 1:
+        raise ValueError("prompt length must be >= 1")
+    if n > cap:
+        raise ValueError(
+            f"prompt length ({n}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's max_len ({max_len})")
+    return min(max(1 << (n - 1).bit_length(), min_bucket), cap)
+
+
+def decode_segments(bucket: int, max_new_tokens: int,
+                    chunk: int) -> list:
+    """The static segment plan for a windowed decode: a list of
+    (start_step, seg_len, window) covering scan steps 0..max_new_tokens-2
+    (step s writes cache slot bucket+s; the first generated token comes
+    from prefill).  `window` is the chunk-rounded cover of the segment's
+    highest written slot, and segments are additionally capped at `chunk`
+    steps so the early-exit host check runs at least once per chunk."""
+    if chunk < 1:
+        raise ValueError("chunk must be >= 1")
+    segs = []
+    s = 0
+    while s <= max_new_tokens - 2:
+        w = _round_up(bucket + s + 1, chunk)
+        last = min(w - bucket - 1, s + chunk - 1, max_new_tokens - 2)
+        segs.append((s, last - s + 1, w))
+        s = last + 1
+    return segs
+
+
+def _make_sampler(temperature: float, top_k, top_p):
+    """A `(logits (B, V), row_keys (B,), step) -> tokens (B,)` sampler with
+    per-row keys: each row's stream is `fold_in(row_key, step)`, so a
+    row's draws depend only on (its key, the step index) — never on which
+    rows share its batch or how groups were formed."""
+    if temperature <= 0.0:
+        def sample(logits, row_keys, step):
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        def sample(logits, row_keys, step):
+            filtered = filter_logits(
+                logits.astype(jnp.float32) / temperature, top_k, top_p)
+            keys = jax.vmap(lambda k: jax.random.fold_in(k, step))(row_keys)
+            return jax.vmap(jax.random.categorical)(
+                keys, filtered).astype(jnp.int32)
+    return sample
+
+
+def _make_stop_check(stop_tokens: tuple):
+    if not stop_tokens:
+        return lambda tok: jnp.zeros(tok.shape, bool)
+    stops = jnp.asarray(list(stop_tokens), jnp.int32)
+    return lambda tok: (tok[:, None] == stops[None, :]).any(axis=-1)
+
+
+def _decode_block(module, bp: dict, x: jax.Array, k_cache: jax.Array,
+                  v_cache: jax.Array, slot, visible, dtype):
+    """One TransformerBlock for a single decode token: write K/V at cache
+    `slot` (shared across rows — decode slots sit after the bucket's pad
+    tail), attend under the per-row `visible` mask (true-prompt slots plus
+    decode slots written so far), MLP as in `_block_with_cache`."""
+    from mmlspark_tpu.ops.attention import single_query_attention
+    n_heads = module.n_heads
+    b, s, d = x.shape
+    dh = d // n_heads
+    h = _ln(bp["LayerNorm_0"], x, dtype)
+    qkv = _dense(bp["qkv"], h, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, 1, n_heads, dh)
+    q, k, v = (t.reshape(shape) for t in (q, k, v))
+    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                       (0, slot, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                       (0, slot, 0, 0))
+    o = single_query_attention(q[:, 0], k_cache, v_cache, visible)
+    x = x + _dense(bp["proj"], o.reshape(b, 1, d).astype(dtype), dtype)
+    h2 = _ln(bp["LayerNorm_1"], x, dtype)
+    return x + _mlp(module, bp, h2, dtype), k_cache, v_cache
+
+
+def _decode_step(params: dict, tok: jax.Array, pos: jax.Array, slot,
+                 caches: list, visible, module):
+    """Logits (B, V) for one decode token per row: per-row positions `pos`
+    (true prompt length + step — NOT the shared cache slot), shared write
+    `slot`, per-row attention visibility."""
+    dtype = module.dtype
+    emb = (params["tok_embed"]["embedding"][tok]
+           + params["pos_embed"]["embedding"][pos])
+    x = emb[:, None].astype(dtype)
+    new_caches = []
+    for i in range(module.n_layers):
+        x, kc, vc = _decode_block(module, params[f"block{i}_w"], x,
+                                  caches[i][0], caches[i][1], slot,
+                                  visible, dtype)
+        new_caches.append((kc, vc))
+    x = _ln(params["final_norm_w"], x, dtype)
+    logits = _dense(params["lm_head"], x, dtype).astype(jnp.float32)
+    return logits[:, 0], new_caches
+
+
+def _grow_cache(cache: jax.Array, window: int) -> jax.Array:
+    """Zero-extend a cache prefix to `window` slots (static shapes)."""
+    w_in = cache.shape[1]
+    if w_in == window:
+        return cache
+    pad = [(0, 0), (0, window - w_in), (0, 0), (0, 0)]
+    return jnp.pad(cache, pad)
+
+
+class DecodeEngine:
+    """Bucketed, cache-windowed, early-exit generation for one sampling
+    configuration (the module docstring has the design).
+
+    Two jitted programs serve every bucket: `_prefill` (specialized per
+    (batch, bucket) shape) and `_segment` (specialized per (batch,
+    window-in, window, seg_len) — bucket and step offsets are traced
+    scalars, so buckets whose windows coincide share compiled segments).
+    `compiled_programs` counts the distinct shape classes built so far —
+    the number the ragged-workload bench pins.
+
+    Greedy token parity with `make_generate_fn`'s full-cache per-length
+    decoder is exact at float32 (test-pinned): pad slots carry exactly
+    zero attention weight and positions are per-row true positions, so
+    bucketing and windowing are pure layout.  For bfloat16 bundles the
+    same caveat as the module docstring's recompute-parity note applies:
+    padded-shape matmuls can tile differently at bf16 resolution, so
+    near-tie greedy choices (top-2 gap of one bf16 ulp) may legitimately
+    resolve differently between bucket layouts.
+    """
+
+    def __init__(self, module, max_new_tokens: int, *,
+                 temperature: float = 0.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 stop_tokens: tuple = (),
+                 chunk: int = DEFAULT_CACHE_CHUNK,
+                 min_bucket: int = DEFAULT_MIN_BUCKET):
+        _check_generatable(module)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if max_new_tokens >= module.max_len:
+            raise ValueError(
+                f"max_new_tokens ({max_new_tokens}) leaves no room for a "
+                f"prompt within max_len ({module.max_len})")
+        if top_k is not None and top_k < 1:
+            raise ValueError("top_k must be >= 1")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if chunk < 1:
+            raise ValueError("chunk must be >= 1")
+        stop_tokens = tuple(int(t) for t in stop_tokens or ())
+        for t in stop_tokens:
+            if not 0 <= t < module.vocab_size:
+                raise ValueError(
+                    f"stop token {t} outside the vocabulary "
+                    f"(0..{module.vocab_size - 1})")
+        self.module = module
+        self.max_new_tokens = max_new_tokens
+        self.stop_tokens = stop_tokens
+        self.chunk = chunk
+        self.min_bucket = min_bucket
+        greedy = temperature <= 0.0
+        sample = _make_sampler(temperature,
+                               None if greedy else top_k,
+                               None if greedy else top_p)
+        is_stop = _make_stop_check(stop_tokens)
+
+        def prefill_impl(variables, prompts, true_len, live, row_keys):
+            params = variables["params"]
+            b, p = prompts.shape
+            w0 = _round_up(p + 1, chunk)
+            dh = module.d_model // module.n_heads
+            caches = [(jnp.zeros((b, w0, module.n_heads, dh), module.dtype),
+                       jnp.zeros((b, w0, module.n_heads, dh), module.dtype))
+                      for _ in range(module.n_layers)]
+            logits, caches = _forward_with_cache(params, prompts, caches,
+                                                 0, module)
+            last = jnp.take_along_axis(
+                logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
+            tok = sample(last, row_keys, 0)
+            done = ~live | is_stop(tok)
+            return tok, done, caches
+
+        def segment_impl(seg_len, window, variables, caches, tok, done,
+                         true_len, bucket, t0, row_keys):
+            params = variables["params"]
+            caches = [(_grow_cache(kc, window), _grow_cache(vc, window))
+                      for kc, vc in caches]
+            slots = jnp.arange(window)
+
+            def step(carry, s_off):
+                tok, done, caches = carry
+                t = t0 + s_off
+                slot = bucket + t
+                pos = true_len + t
+                visible = ((slots[None, :] < true_len[:, None])
+                           | ((slots[None, :] >= bucket)
+                              & (slots[None, :] <= slot)))
+                logits, caches = _decode_step(params, tok, pos, slot,
+                                              caches, visible, module)
+                nxt = sample(logits, row_keys, t + 1)
+                nxt = jnp.where(done, tok, nxt)
+                return (nxt, done | is_stop(nxt), caches), tok
+
+            (tok, done, caches), toks = lax.scan(
+                step, (tok, done, caches), jnp.arange(seg_len))
+            return caches, toks.transpose(1, 0), tok, done
+
+        self._prefill = jax.jit(prefill_impl)
+        self._segment = jax.jit(segment_impl, static_argnums=(0, 1))
+        self._programs: set = set()
+        self.last_segments_run = 0
+        self.last_new_tokens_computed = 0
+
+    def bucket_for(self, prompt_len: int) -> int:
+        return bucket_length(prompt_len, self.module.max_len,
+                             self.max_new_tokens, self.min_bucket)
+
+    @property
+    def compiled_programs(self) -> int:
+        """Distinct compiled shape classes (prefill + segment) so far —
+        mirrors jit's specialization key, so it counts real XLA programs."""
+        return len(self._programs)
+
+    def generate(self, variables, prompts, true_len, *, rng=None,
+                 row_ids=None, live=None) -> np.ndarray:
+        """Generate `max_new_tokens` per row: prompts (B, bucket) int32
+        right-padded, true_len (B,) per-row prompt lengths.  Returns the
+        GENERATED region (B, max_new_tokens) — after a row's first stop
+        token the remaining slots repeat that token (and once every live
+        row has stopped, the remaining segments are skipped entirely).
+
+        `row_ids` is the stable per-row sampling-stream id (defaults to
+        0..B-1); `live=False` rows (mesh shard padding) are born done so
+        they never hold the batch open.  Arrays may be host numpy or
+        already-placed device arrays (the mesh path shards them first).
+        """
+        b, p = np.shape(prompts)[0], np.shape(prompts)[1]
+        tl_host = np.asarray(true_len)
+        if int(tl_host.max()) > p:
+            raise ValueError(
+                f"true_len ({int(tl_host.max())}) exceeds the prompt "
+                f"bucket width ({p})")
+        if int(tl_host.max()) + self.max_new_tokens > self.module.max_len:
+            raise ValueError(
+                f"prompt_len ({int(tl_host.max())}) + max_new_tokens "
+                f"({self.max_new_tokens}) exceeds the model's max_len "
+                f"({self.module.max_len})")
+        base = rng if rng is not None else jax.random.key(0)
+        ids = jnp.arange(b) if row_ids is None else jnp.asarray(row_ids)
+        row_keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(ids)
+        if live is None:
+            live = np.ones(b, bool)
+        timings = active_timings()
+        with span_on(timings, "prefill"):
+            tok, done, caches = self._prefill(variables, jnp.asarray(prompts),
+                                              jnp.asarray(true_len),
+                                              jnp.asarray(live), row_keys)
+            if timings is not None:
+                jax.block_until_ready(tok)
+        self._programs.add(("prefill", b, p))
+        segs = decode_segments(p, self.max_new_tokens, self.chunk)
+        check_exit = bool(self.stop_tokens)
+        prev_w = _round_up(p + 1, self.chunk)
+        parts = []
+        segments_run = 0
+        with span_on(timings, "decode"):
+            for t0, seg_len, window in segs:
+                if check_exit and bool(np.asarray(jax.device_get(done)).all()):
+                    break
+                caches, toks, tok, done = self._segment(
+                    seg_len, window, variables, caches, tok, done,
+                    jnp.asarray(true_len), jnp.asarray(p, jnp.int32),
+                    jnp.asarray(t0, jnp.int32), row_keys)
+                self._programs.add(("segment", b, prev_w, window, seg_len))
+                prev_w = window
+                parts.append(toks)
+                segments_run += 1
+            generated = np.concatenate(
+                [np.asarray(x) for x in parts]
+                + [np.asarray(tok)[:, None]], axis=1)
+        self.last_segments_run = segments_run
+        self.last_new_tokens_computed = generated.shape[1]
+        if generated.shape[1] < self.max_new_tokens:
+            # early exit: every row is frozen on its stop token — the fill
+            # is exactly what the skipped segments would have emitted
+            fill = np.repeat(np.asarray(tok)[:, None],
+                             self.max_new_tokens - generated.shape[1], axis=1)
+            generated = np.concatenate([generated, fill], axis=1)
+        return generated.astype(np.int32)
+
+
 class TextGenerator(Transformer):
     """Pipeline Transformer: a token-prompt column in, a generated-token
     column out — the LM counterpart of TPUModel's scoring loop.
 
-    Rows are grouped by prompt length (each length is its own compiled
-    shape class — the same static-shape discipline as
-    vision/transformer.py's ragged grouping) and decoded through the
-    jit-once KV-cache program; output rows align with input rows.
+    Rows are grouped by prompt BUCKET (next power of two — a handful of
+    compiled shape classes scoring full batches, the same static-shape
+    discipline as vision/transformer.py's ragged grouping, now shared
+    across prompt lengths) and decoded through the `DecodeEngine`:
+    bucketed prefill, cache-windowed segments, stop-token early exit.
+    Output rows align with input rows.  Greedy tokens are exactly those
+    of per-length decoding (engine contract); sampled rows draw from a
+    per-row stream keyed on (seed, row position), so a row's sample never
+    depends on which rows share its table or batch.
+
+    With `stopTokens` set, each output row is trimmed after its first
+    stop token (the stop token is kept), and a batch whose rows have all
+    stopped exits decode early.  `beamWidth > 0` routes through the
+    full-cache per-length beam program instead (windowing lands
+    sampler-first — docs/performance.md).
 
     MoE models: each decode step routes its batch as one capacity-limited
     group, so a row's generations can depend on which rows share its
-    batch (dense models are row-independent) — see `_mlp`.
+    batch (dense models are row-independent) — see `_mlp`; bucket pad
+    rows never enter the cache a real row attends, but under MoE they do
+    join the step's capacity groups (the same coupling mesh zero-pad rows
+    already have).
     """
 
     inputCol = Param(None, "column of int token-id prompt arrays",
@@ -416,9 +774,23 @@ class TextGenerator(Transformer):
                  ptype=float, validator=lambda v: 0 < v <= 1)
     beamWidth = Param(0, "deterministic beam search width; each row "
                       "emits its best beam (0 = off; overrides "
-                      "temperature/topK/topP)", ptype=int,
+                      "temperature/topK/topP; full-cache per-length "
+                      "path)", ptype=int,
                       validator=lambda v: v >= 0)
-    seed = Param(0, "sampling seed (ignored when greedy)", ptype=int)
+    seed = Param(0, "sampling seed (ignored when greedy); each row's "
+                 "stream also folds in its table position, so draws are "
+                 "grouping-independent", ptype=int)
+    stopTokens = Param(None, "token ids that end a row's generation: the "
+                       "row is trimmed after its first stop token "
+                       "(kept), and a batch whose rows have all stopped "
+                       "exits decode early (None/empty = off; ignored "
+                       "by beam search)", ptype=(list, tuple))
+    cacheChunk = Param(DEFAULT_CACHE_CHUNK, "decode cache-window growth "
+                       "granularity in slots: each compiled decode "
+                       "segment attends only over the cache prefix "
+                       "rounded up to this, so steady-step cost scales "
+                       "with occupancy, not max_len", ptype=int,
+                       validator=lambda v: v >= 1)
 
     def __init__(self, bundle: Optional["ModelBundle"] = None, **kwargs):
         super().__init__(**kwargs)
@@ -451,30 +823,110 @@ class TextGenerator(Transformer):
     def bundle(self) -> Optional["ModelBundle"]:
         return self._bundle
 
-    def _fn_for(self, prompt_len: int):
-        if self.beamWidth > 0:
-            key = ("beam", prompt_len, self.maxNewTokens, self.beamWidth)
-            if key not in self._compiled:
-                beam_fn = make_beam_search_fn(
-                    self._bundle.module(), prompt_len, self.maxNewTokens,
-                    self.beamWidth)
-                # uniform (variables, prompts, key) signature; the stage
-                # emits each row's BEST beam
-                self._compiled[key] = (
-                    lambda v, p, _k, fn=beam_fn: fn(v, p)[0][:, 0])
-            return self._compiled[key]
+    def _beam_fn_for(self, prompt_len: int):
+        key = ("beam", prompt_len, self.maxNewTokens, self.beamWidth)
+        if key not in self._compiled:
+            beam_fn = make_beam_search_fn(
+                self._bundle.module(), prompt_len, self.maxNewTokens,
+                self.beamWidth)
+            # the stage emits each row's BEST beam
+            self._compiled[key] = lambda v, p, fn=beam_fn: fn(v, p)[0][:, 0]
+        return self._compiled[key]
+
+    def _engine_for(self) -> DecodeEngine:
         # greedy ignores the filters: normalize them out of the cache key
-        # so flipping topK/topP at temperature 0 never recompiles
+        # so flipping topK/topP at temperature 0 never rebuilds the engine
         sampling = self.temperature > 0
         top_k = (self.topK or None) if sampling else None
         top_p = self.topP if sampling and self.topP < 1.0 else None
-        key = (prompt_len, self.maxNewTokens, self.temperature,
-               top_k, top_p)
+        stops = tuple(int(t) for t in (self.stopTokens or ()))
+        key = ("engine", self.maxNewTokens, self.temperature, top_k, top_p,
+               stops, self.cacheChunk)
         if key not in self._compiled:
-            self._compiled[key] = make_generate_fn(
-                self._bundle.module(), prompt_len, self.maxNewTokens,
-                self.temperature, top_k=top_k, top_p=top_p)
+            self._compiled[key] = DecodeEngine(
+                self._bundle.module(), self.maxNewTokens,
+                temperature=self.temperature, top_k=top_k, top_p=top_p,
+                stop_tokens=stops, chunk=self.cacheChunk)
         return self._compiled[key]
+
+    def _device_variables(self):
+        """Weights replicated once per mesh (the TPUModel discipline)."""
+        if self._mesh is None:
+            return self._bundle.variables
+        if self._mesh not in self._device_vars:
+            from mmlspark_tpu.parallel.bridge import replicate_tree
+            self._device_vars[self._mesh] = replicate_tree(
+                self._bundle.variables, self._mesh)
+        return self._device_vars[self._mesh]
+
+    def _transform_beam(self, rows: list, out: list) -> None:
+        """Beam rows decode through the full-cache per-length programs."""
+        by_len: dict[int, list[int]] = {}
+        for i, r in enumerate(rows):
+            by_len.setdefault(len(r), []).append(i)
+        for plen, idxs in sorted(by_len.items()):
+            fn = self._beam_fn_for(plen)
+            prompts = np.stack([rows[i] for i in idxs])
+            variables = self._device_variables()
+            if self._mesh is not None:
+                from mmlspark_tpu.parallel.bridge import (pad_to_multiple,
+                                                          put_sharded)
+                from mmlspark_tpu.parallel.mesh import batch_sharding
+                data = self._mesh.shape["data"]
+                prompts, _ = pad_to_multiple(prompts, data)
+                # one straight-to-sharded transfer (no default-device hop)
+                prompts = put_sharded(prompts, batch_sharding(self._mesh))
+            else:
+                prompts = jnp.asarray(prompts)
+            got = np.asarray(fn(variables, prompts))
+            for j, i in enumerate(idxs):
+                out[i] = got[j]
+
+    def _transform_engine(self, rows: list, out: list) -> None:
+        """Sampler/greedy rows decode through the bucketed engine."""
+        engine = self._engine_for()
+        n = len(rows)
+        by_bucket: dict[int, list[int]] = {}
+        for i, r in enumerate(rows):
+            by_bucket.setdefault(engine.bucket_for(len(r)), []).append(i)
+        base = jax.random.key(self.seed)
+        stops = np.asarray(engine.stop_tokens, np.int32)
+        for bucket, idxs in sorted(by_bucket.items()):
+            b = len(idxs)
+            prompts = np.zeros((b, bucket), np.int32)
+            true_len = np.empty(b, np.int32)
+            for j, i in enumerate(idxs):
+                true_len[j] = len(rows[i])
+                prompts[j, :true_len[j]] = rows[i]
+            live = np.ones(b, bool)
+            # the per-row sampling-stream id is the row's TABLE position:
+            # stable under any grouping or batch composition
+            row_ids = np.asarray(idxs, np.int32)
+            variables = self._device_variables()
+            if self._mesh is not None:
+                from mmlspark_tpu.parallel.bridge import put_batch_parts
+                data = self._mesh.shape["data"]
+                pad = -(-b // data) * data - b
+                if pad:
+                    prompts = np.pad(prompts, ((0, pad), (0, 0)))
+                    # pad rows: length-1 zero prompts, born not-live (the
+                    # engine marks them done so they never hold the batch
+                    # open), unique stream ids past the real rows
+                    true_len = np.pad(true_len, (0, pad), constant_values=1)
+                    live = np.pad(live, (0, pad))
+                    row_ids = np.concatenate(
+                        [row_ids, n + np.arange(pad, dtype=np.int32)])
+                prompts, true_len, live = put_batch_parts(
+                    self._mesh, prompts, true_len, live)
+            got = engine.generate(variables, prompts, true_len, rng=base,
+                                  row_ids=row_ids, live=live)
+            for j, i in enumerate(idxs):
+                gen = got[j]
+                if stops.size:
+                    hits = np.isin(gen, stops).nonzero()[0]
+                    if hits.size:
+                        gen = gen[:hits[0] + 1]
+                out[i] = np.concatenate([rows[i], gen])
 
     def transform(self, table: "DataTable") -> "DataTable":
         self._check_required()
@@ -485,35 +937,11 @@ class TextGenerator(Transformer):
         rows = [np.asarray(r, np.int32) for r in col]
         n = len(rows)
         out: list = [None] * n
-        by_len: dict[int, list[int]] = {}
-        for i, r in enumerate(rows):
-            by_len.setdefault(len(r), []).append(i)
-        for plen, idxs in sorted(by_len.items()):
-            fn = self._fn_for(plen)
-            prompts = np.stack([rows[i] for i in idxs])
-            variables = self._bundle.variables
-            if self._mesh is not None:
-                from mmlspark_tpu.parallel.bridge import (pad_to_multiple,
-                                                          replicate_tree)
-                from mmlspark_tpu.parallel.mesh import batch_sharding
-                data = self._mesh.shape["data"]
-                padded = -(-len(idxs) // data) * data
-                prompts, _ = pad_to_multiple(prompts, padded)
-                # one straight-to-sharded transfer (no default-device hop);
-                # weights replicate once per mesh (the TPUModel discipline)
-                prompts = jax.device_put(prompts,
-                                         batch_sharding(self._mesh))
-                if self._mesh not in self._device_vars:
-                    self._device_vars[self._mesh] = replicate_tree(
-                        variables, self._mesh)
-                variables = self._device_vars[self._mesh]
-            else:
-                prompts = jnp.asarray(prompts)
-            key = jax.random.key(self.seed)
-            got = np.asarray(fn(variables, prompts, key))
-            for j, i in enumerate(idxs):
-                out[i] = got[j]
-        if n and len(by_len) == 1:
+        if self.beamWidth > 0:
+            self._transform_beam(rows, out)
+        else:
+            self._transform_engine(rows, out)
+        if n and len({len(r) for r in out}) == 1:
             return table.with_column(self.outputCol, np.stack(out))
         result = np.empty(n, object)
         for i, r in enumerate(out):

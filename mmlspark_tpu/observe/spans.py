@@ -10,7 +10,14 @@ thread-time went to each PIPELINE PHASE —
     compute   jitted dispatch of the model step
     drain     blocking device->host fetch of results
 
-— and which phase is the bottleneck.  Spans are recorded from both the
+— and which phase is the bottleneck.  Autoregressive generation adds its
+own two phases (GENERATE_STAGES, recorded by the decode engine in
+models/generate.py): `prefill` (the prompt forward that writes the KV
+cache) and `decode` (the windowed per-token segments, including their
+between-segment early-exit checks).  They ride the same collector and
+show up in `summary()` as stage_prefill_s / stage_decode_s whenever a
+`pipeline_timing()` block wraps a TextGenerator.transform — the split
+that tells prompt-bound serving apart from generation-bound serving.  Spans are recorded from both the
 consumer thread and the prefetcher's staging workers (thread-safe), so
 overlapped phases each report their full cost: totals are thread-seconds,
 not wall, and under a healthy pipeline their sum EXCEEDS wall time —
@@ -32,6 +39,9 @@ import time
 from typing import Iterator, Optional
 
 STAGES = ("host", "transfer", "compute", "drain")
+# generation phases (models/generate.py DecodeEngine); reported by
+# summary() only when recorded, so scoring/training summaries stay 4-stage
+GENERATE_STAGES = ("prefill", "decode")
 
 _collector: contextvars.ContextVar[Optional["PipelineTimings"]] = \
     contextvars.ContextVar("mmlspark_tpu_pipeline_timings", default=None)

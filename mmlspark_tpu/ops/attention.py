@@ -7,6 +7,11 @@ TPU-native framework makes sequence/context parallelism first-class:
 
   * `attention`        — standard dense multi-head attention (one device's
                          whole sequence; XLA fuses QK^T -> softmax -> @V).
+  * `single_query_attention` — one decode step's query against a KV-cache
+                         *window* under an explicit per-row visibility mask;
+                         the building block of the cache-windowed decode
+                         engine (models/generate.py): cost scales with the
+                         window it is handed, not the model's max_len.
   * `ring_attention`   — sequence sharded over a mesh axis; K/V blocks
                          rotate around the ring via ppermute while each
                          device accumulates online-softmax partials, so
@@ -53,6 +58,35 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def single_query_attention(q: jax.Array, k_cache: jax.Array,
+                           v_cache: jax.Array, visible: jax.Array,
+                           scale: Optional[float] = None) -> jax.Array:
+    """One decode step's query against a KV-cache window.
+
+    q: (B, H, D) — the single new token's query per row.
+    k_cache, v_cache: (B, L, H, D) — a *prefix window* of the full cache;
+        the caller sizes L to the current occupancy (rounded up to a
+        chunk), so per-step bandwidth scales with how much cache is
+        actually written, not with the model's max_len.
+    visible: (B, L) bool — True where the query may attend.  Per-row,
+        because bucketed prompts leave per-row pad holes between each
+        row's true prompt and the shared decode slots; masked slots get
+        exactly zero weight (NEG_INF -> exp underflows to 0.0), so layout
+        padding never changes the math.
+
+    Accumulates QK^T and PV in float32 (the single-query step is
+    bandwidth-bound — the extra precision is free; same discipline as the
+    full-cache decode path).  Returns (B, H, D) float32.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    s = jnp.where(visible[:, None, :], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhl,blhd->bhd", w, v_cache.astype(jnp.float32))
 
 
 def _block_scores(q, k, scale):

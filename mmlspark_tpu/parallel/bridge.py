@@ -62,6 +62,24 @@ def shard_table_columns(table, columns: Sequence[str], mesh: Mesh,
     return out, valid
 
 
+def put_batch_parts(mesh: Mesh, *arrays: np.ndarray,
+                    axis: str = DATA_AXIS) -> tuple:
+    """device_put several row-aligned host arrays with the mesh batch
+    sharding, one straight-to-sharded transfer each (no default-device
+    hop).  Leading dims must already be shard-divisible — callers that
+    pad rows carry per-array pad values (a true-length pads with 1, a
+    liveness mask with False), so padding stays theirs.  The bucketed
+    decode path stages prompts + true lengths + live masks in lockstep."""
+    sharding = batch_sharding(mesh, axis=axis)
+    for a in arrays:
+        if a.shape[0] % mesh.shape[axis]:
+            raise ValueError(
+                f"leading dim {a.shape[0]} not divisible by the mesh "
+                f"'{axis}' axis ({mesh.shape[axis]}); pad rows first "
+                f"(pad_to_multiple)")
+    return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
 def put_sharded(local: np.ndarray, sharding: NamedSharding) -> jax.Array:
     """Assemble a global device array from this process's local rows.
 

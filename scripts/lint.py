@@ -37,6 +37,7 @@ RESILIENCE_DIR = os.path.join("mmlspark_tpu", "resilience")
 # raw jax.device_put bypasses the bridge/prefetch transfer layer
 HOT_LOOP_FILES = {
     os.path.join("mmlspark_tpu", "models", "tpu_model.py"),
+    os.path.join("mmlspark_tpu", "models", "generate.py"),
     os.path.join("mmlspark_tpu", "train", "trainer.py"),
     os.path.join("mmlspark_tpu", "train", "learner.py"),
     os.path.join("mmlspark_tpu", "stages", "basic.py"),

@@ -263,6 +263,44 @@ def test_filter_logits_top_k_and_top_p():
         np.asarray(logits, np.float32))
 
 
+def test_filter_logits_edge_cases():
+    """The corners sampling only exercises by accident: filters that cover
+    the whole vocabulary are identities, exact ties at the nucleus cutoff
+    never split, and fully-masked rows stay finite (no NaN from the
+    internal softmax) so a downstream categorical cannot crash."""
+    from mmlspark_tpu.models.generate import NEG_INF, filter_logits
+
+    logits = jnp.asarray([[3.0, 1.0, 2.0, 0.0, -1.0]])
+    ref = np.asarray(logits, np.float32)
+    # top_k covering the vocab (k == V and k > V) is an identity
+    np.testing.assert_array_equal(np.asarray(filter_logits(logits, top_k=5)),
+                                  ref)
+    np.testing.assert_array_equal(np.asarray(filter_logits(logits, top_k=9)),
+                                  ref)
+    # top_p = 1.0 is the documented off switch — identity, not "keep all
+    # but the last"
+    np.testing.assert_array_equal(
+        np.asarray(filter_logits(logits, top_p=1.0)), ref)
+    # exact ties AT the nucleus cutoff are all kept: the cutoff is a logit
+    # VALUE, so two tokens with identical logits stand or fall together
+    # even when the nucleus mass is reached inside the tie
+    tied = jnp.asarray([[2.0, 2.0, 0.0, -8.0, -8.0]])
+    for p in (0.3, 0.5):  # mass reached at the 1st and 2nd tie member
+        kept = np.asarray(filter_logits(tied, top_p=p))[0]
+        assert kept[0] > NEG_INF / 2 and kept[1] > NEG_INF / 2, p
+        assert (kept[2:] <= NEG_INF / 2).all(), p
+    # an all-NEG_INF row (every token already masked upstream) must come
+    # through finite and fully masked under both filters, alone and
+    # stacked beside a healthy row
+    dead = jnp.full((1, 5), NEG_INF)
+    both = jnp.concatenate([logits, dead])
+    for out in (filter_logits(dead, top_k=2), filter_logits(dead, top_p=0.5),
+                filter_logits(both, top_k=2, top_p=0.5)[1:]):
+        arr = np.asarray(out)
+        assert not np.isnan(arr).any()
+        assert (arr <= NEG_INF / 2).all()
+
+
 @pytest.mark.slow
 def test_top_k_one_equals_greedy(lm_bundle):
     """top_k=1 collapses temperature sampling to greedy exactly — the

@@ -20,7 +20,8 @@ lint:             ## AST lint (unused imports, bare except, tabs)
 bench:            ## full benchmark on the available backend
 	python bench.py
 
-bench-smoke:      ## tiny-size bench (JSON contract check)
+bench-smoke:      ## lint + tiny-size bench incl. quantized arms (JSON contract check, no TPU needed)
+	python scripts/lint.py
 	python bench.py --smoke
 
 tpu-floors:       ## throughput/MFU floors on a real TPU chip

@@ -22,10 +22,13 @@ line read the last one):
   2. resnet50_224 — the MXU-bound workload (ImageFeaturizerSuite.scala:45-53
      class): end-to-end images/sec/chip plus `device_images_per_sec` /
      `device_mfu` for the HBM-resident steady state (what the chip itself
-     sustains once the transfer link is out of the picture).
+     sustains once the transfer link is out of the picture), and the
+     quantization dtype ladder (f32 / bf16 / int8 device rates over the
+     same weights, same invocation — docs/performance.md).
   3. cifar10_convnet — the headline notebook-301 metric, best-of-N reps
      (tunneled-link variance burned round 2: 8442 -> 4852 img/s with
-     byte-identical code), with an `mfu` field.
+     byte-identical code), with an `mfu` field and the int8 quantized arm
+     gated by its accuracy delta on the real held-out split.
 
 Lines 2 and 3 carry a link-bandwidth probe taken adjacent to their
 measurement so throughput swings are attributable to link weather vs code.
@@ -199,6 +202,21 @@ def bench_convnet(smoke: bool) -> dict:
         DataTable({"image": x_test}))
     accuracy = float((np.argmax(scored["scores"], axis=1) == y_test).mean())
 
+    # int8 quantized arm: the SAME trained weights, weight-only PTQ
+    # (quant/quantize.py), with its accuracy gate right next to its
+    # speedup — a quantized rate without an accuracy delta is how silent
+    # quality regressions ship (tests/test_perf_floor.py pins the delta)
+    from mmlspark_tpu.quant import accuracy_gate, quantize_bundle
+    q_bundle = quantize_bundle(bundle, "int8")
+    q_model = TPUModel(q_bundle, inputCol="image", outputCol="scores",
+                       miniBatchSize=batch)
+    q_model.transform(table.take(batch))  # warmup: compile quantized fwd
+    int8_dev_ips = device_steady_state(q_model, table, "image", batch,
+                                       1 if smoke else 4)
+    gate = accuracy_gate(model.copy(miniBatchSize=128),
+                         q_model.copy(miniBatchSize=128),
+                         DataTable({"image": x_test}), y_test)
+
     fpi = _flops_per_image(bundle, (batch, 32, 32, 3), "convnet_cifar10")
     off_ips = n_images / best_off / n_chips
     return {
@@ -230,6 +248,13 @@ def bench_convnet(smoke: bool) -> dict:
             norm_ips / TARGET_IMAGES_PER_SEC_PER_CHIP, 3),
         "accuracy": round(accuracy, 4),
         "accuracy_dataset": "UCI digits held-out (trained zoo bundle)",
+        # the quantized arm + its gate (quant/gate.py): speedup and
+        # accuracy delta from the SAME invocation, same weights
+        "int8_device_images_per_sec": round(int8_dev_ips, 1),
+        "int8_device_speedup": round(int8_dev_ips / dev_ips, 3),
+        "int8_accuracy": gate["quant_accuracy"],
+        "int8_accuracy_delta": gate["accuracy_delta"],
+        "int8_agreement": gate["agreement"],
         "reps": reps,
         **link,
     }
@@ -247,12 +272,19 @@ def bench_resnet50(smoke: bool) -> dict:
     batch = 32 if smoke else 256
     device_iters = 2 if smoke else 10
 
-    bundle = ModelBundle.init(resnet50(), (1, 224, 224, 3), seed=0)
+    # base bundle is built FLOAT32 so the dtype arms are attributable: the
+    # headline arm overrides computeDtype to bfloat16 (exactly the compute
+    # the old bf16-built module ran — the standard TPU recipe), and the
+    # f32 arm is the same weights with no override.  On TPU the bf16 rate
+    # must strictly beat f32 in this same invocation (test_perf_floor).
+    import jax.numpy as jnp
+    bundle = ModelBundle.init(resnet50(dtype=jnp.float32), (1, 224, 224, 3),
+                              seed=0)
     rng = np.random.default_rng(0)
     imgs = rng.integers(0, 256, size=(n_images, 224, 224, 3), dtype=np.uint8)
     table = DataTable({"image": imgs})
     model = TPUModel(bundle, inputCol="image", outputCol="scores",
-                     miniBatchSize=batch)
+                     miniBatchSize=batch, computeDtype="bfloat16")
     model.transform(table.take(batch))  # warmup
 
     # 1) end-to-end: host batches through the transfer link (best of 2 —
@@ -275,6 +307,18 @@ def bench_resnet50(smoke: bool) -> dict:
     #    number — what the chip sustains when the corpus is already on device.
     dev_ips = device_steady_state(model, table, "image", batch, device_iters)
 
+    # dtype arms over the SAME weights and corpus: f32 (no override) and
+    # int8 weight-only PTQ — speedups are same-invocation, same-chip
+    from mmlspark_tpu.quant import quantize_bundle
+    f32_model = TPUModel(bundle, inputCol="image", outputCol="scores",
+                         miniBatchSize=batch)
+    f32_dev_ips = device_steady_state(f32_model, table, "image", batch,
+                                      device_iters)
+    q_model = TPUModel(quantize_bundle(bundle, "int8"), inputCol="image",
+                       outputCol="scores", miniBatchSize=batch)
+    int8_dev_ips = device_steady_state(q_model, table, "image", batch,
+                                       device_iters)
+
     # link-normalized rate, same arithmetic as the convnet gate line
     # (docs/perf.md "The 4x gate") — the 224px workload moves ~150 KB/image
     # over the tunnel, so raw e2e rides link weather hardest of any line;
@@ -294,6 +338,13 @@ def bench_resnet50(smoke: bool) -> dict:
         "mfu": round(m, 5) if (m := mfu(e2e_ips, fpi)) is not None else None,
         "device_images_per_sec": round(dev_ips, 1),
         "device_mfu": round(dev_mfu, 4) if dev_mfu is not None else None,
+        # dtype ladder, same weights same invocation: the MXU-bound
+        # workload's quantization story (docs/performance.md)
+        "f32_device_images_per_sec": round(f32_dev_ips, 1),
+        "bf16_device_images_per_sec": round(dev_ips, 1),
+        "bf16_vs_f32_speedup": round(dev_ips / f32_dev_ips, 3),
+        "int8_device_images_per_sec": round(int8_dev_ips, 1),
+        "int8_vs_bf16_speedup": round(int8_dev_ips / dev_ips, 3),
         "link_normalized_images_per_sec": round(norm_ips, 1),
         **link,
     }
@@ -456,6 +507,11 @@ def bench_lm_decode(smoke: bool) -> dict:
     2. WINDOWED steady step (DecodeEngine) at ~25% cache occupancy: same
        differencing, but the compiled segment attends only over the
        chunk-rounded cache prefix — the occupancy-scaling claim, measured.
+       2b. the SAME windowed step with an int8 KV cache (quantize-on-
+       write, dequant in the attention read): the bandwidth-halving claim
+       plus its accuracy gate (greedy agreement vs arm 2's tokens), and
+       an analytic kv-bytes/step + hbm_bw_util model so cache wins are
+       attributable to bytes moved.
     3. RAGGED workload (TextGenerator.transform): >= 8 distinct prompt
        lengths through the bucketed engine — compiled-program count (was
        one per length), tokens/sec, and prefill/decode span attribution.
@@ -545,6 +601,49 @@ def bench_lm_decode(smoke: bool) -> dict:
     else:
         windowed_step_ms = w_walls[w_n2] / w_n2 * 1e3
 
+    # -- arm 2b: int8 KV cache at the same occupancy --------------------
+    # same prompts, weights, and window; the cache stores int8 payloads +
+    # per-head f32 scales (quantize-on-write, dequant inside the
+    # attention read) so the steady step streams 1 byte per cached
+    # element where the model-dtype cache streams 2-4.  Greedy agreement
+    # vs arm 2's tokens is the arm's accuracy gate.
+    q_walls = {}
+    for n_new in (w_n1, w_n2):
+        eng = DecodeEngine(model, n_new, chunk=chunk, cache_dtype="int8")
+        eng.generate(variables, w_prompts, w_true)  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            got_int8 = eng.generate(variables, w_prompts, w_true)
+            int(got_int8[0, -1])
+            best = min(best, time.perf_counter() - t0)
+        q_walls[n_new] = best
+    q_delta = q_walls[w_n2] - q_walls[w_n1]
+    if q_delta > 0:
+        int8_kv_step_ms = q_delta / (w_n2 - w_n1) * 1e3
+    else:
+        int8_kv_step_ms = q_walls[w_n2] / w_n2 * 1e3
+    int8_kv_agreement = float((got == got_int8).mean())
+
+    # -- steady-step bandwidth model ------------------------------------
+    # analytic KV bytes READ per compiled decode step (the whole batch —
+    # the bandwidth-bound step's dominant traffic): batch x layers x
+    # {K,V} x slots x heads x head_dim x itemsize; the int8 cache adds
+    # one f32 scale per (slot, head).  hbm_bw_util is that traffic over
+    # the measured full-cache step against the chip's HBM peak — None
+    # when the peak is unknown (CPU).
+    from mmlspark_tpu.utils.perf import device_peak_hbm_bw
+    dh = cfg["d_model"] // cfg["n_heads"]
+    cache_itemsize = jnp.dtype(model.dtype).itemsize
+    per_slot = b * cfg["n_layers"] * 2 * cfg["n_heads"] * dh * cache_itemsize
+    kv_bytes_full = cfg["max_len"] * per_slot
+    kv_bytes_windowed = window * per_slot
+    kv_bytes_int8 = (window * b * cfg["n_layers"] * 2 * cfg["n_heads"]
+                     * (dh + 4))
+    peak_bw = device_peak_hbm_bw()
+    hbm_bw_util = (kv_bytes_full / (step_ms * 1e-3) / peak_bw
+                   if peak_bw else None)
+
     # -- arm 3: ragged workload through the bucketed engine -------------
     rag_rows = np.empty(len(ragged_lengths) * ragged_rows, object)
     k = 0
@@ -585,6 +684,19 @@ def bench_lm_decode(smoke: bool) -> dict:
         "window_occupancy": round(window / cfg["max_len"], 3),
         "windowed_vs_full_speedup": round(step_ms / windowed_step_ms, 3)
         if windowed_step_ms > 0 else None,
+        # int8 KV-cache arm at the same occupancy, with its accuracy gate
+        # (greedy top-1 agreement vs the model-dtype cache) — and the
+        # analytic bandwidth model that makes cache wins attributable
+        "int8_kv_windowed_step_ms": round(int8_kv_step_ms, 3),
+        "int8_kv_vs_model_speedup": round(
+            windowed_step_ms / int8_kv_step_ms, 3)
+        if int8_kv_step_ms > 0 else None,
+        "int8_kv_greedy_agreement": round(int8_kv_agreement, 4),
+        "kv_bytes_per_step": int(kv_bytes_full),
+        "windowed_kv_bytes_per_step": int(kv_bytes_windowed),
+        "int8_kv_bytes_per_step": int(kv_bytes_int8),
+        "hbm_bw_util": round(hbm_bw_util, 4)
+        if hbm_bw_util is not None else None,
         # ragged workload: shape-class consolidation, measured
         "ragged_distinct_lengths": len(ragged_lengths),
         "ragged_compiled_programs": rag_programs,

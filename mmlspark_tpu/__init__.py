@@ -20,6 +20,8 @@ Layer map (mirrors SURVEY.md section 1 of the reference analysis):
   io/        - readers (image/binary/csv) and writers
   resilience/- retry/breaker policies, chaos injection, checkpoint
                rotation, preemption handling (docs/resilience.md)
+  quant/     - post-training quantization: int8/bf16 bundles, fused
+               wrappers, int8 KV cache, accuracy gates (docs/performance.md)
   zoo/       - pretrained model repository client
   native/    - C++ host-side runtime pieces (decode, parse, hash)
 """

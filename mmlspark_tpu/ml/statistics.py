@@ -17,7 +17,8 @@ import numpy as np
 
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Evaluator
-from mmlspark_tpu.core.schema import SchemaConstants, find_score_columns
+from mmlspark_tpu.core.schema import (SchemaConstants, find_score_columns,
+                                      set_score_column)
 from mmlspark_tpu.core.table import DataTable
 
 # metric names (ComputeModelStatistics.scala:26-69)
@@ -286,6 +287,28 @@ class ComputeModelStatistics(Evaluator):
             out = {metric: out[metric]}
         return EvalResult(DataTable({k: [v] for k, v in out.items()}),
                           confusion_matrix=cm, roc=roc)
+
+
+def classification_report(y_true, y_pred, model_uid: str = "model") -> EvalResult:
+    """Evaluate raw predicted class indices against true labels through the
+    full metadata-driven evaluator: builds the one-model mml-tagged table
+    the protocol expects and runs ComputeModelStatistics on it.
+
+    The building block of the quantization accuracy gate
+    (quant/gate.py::accuracy_gate): quantized-vs-f32 comparisons go through
+    the SAME metric path as every other evaluation in the framework, so
+    an accuracy delta in a bench line and one from a notebook agree by
+    construction.
+    """
+    t = DataTable({"label": np.asarray(y_true),
+                   "prediction": np.asarray(y_pred)})
+    set_score_column(t, model_uid, "prediction",
+                     SchemaConstants.SCORED_LABELS_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    set_score_column(t, model_uid, "label",
+                     SchemaConstants.TRUE_LABELS_COLUMN,
+                     SchemaConstants.CLASSIFICATION_KIND)
+    return ComputeModelStatistics(evaluationMetric=ACCURACY).evaluate(t)
 
 
 class ComputePerInstanceStatistics(Evaluator):

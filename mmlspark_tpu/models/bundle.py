@@ -70,8 +70,21 @@ class ModelBundle:
 
     @staticmethod
     def init(module: nn.Module, input_shape: tuple, seed: int = 0,
-             metadata: Optional[dict] = None) -> "ModelBundle":
-        x = np.zeros(input_shape, np.float32)
+             metadata: Optional[dict] = None,
+             input_dtype=None) -> "ModelBundle":
+        """Fresh-init variables for `module` fed zeros of `input_shape`.
+
+        The feed dtype is derived from the module when not given: token-
+        input models (anything with a `vocab_size` field — their first op
+        is an Embed lookup, which requires integer indices) get int32;
+        everything else float32.  Pass `input_dtype` explicitly for custom
+        architectures whose input convention differs.
+        """
+        if input_dtype is None:
+            input_dtype = (np.int32
+                           if getattr(module, "vocab_size", None) is not None
+                           else np.float32)
+        x = np.zeros(input_shape, input_dtype)
         variables = module.init(jax.random.key(seed), x)
         # unfreeze to plain dict for serialization uniformity
         variables = jax.tree_util.tree_map(np.asarray, _to_plain(variables))

@@ -93,6 +93,14 @@ def _ln(p: dict, x: jax.Array, dtype) -> jax.Array:
 
 
 def _dense(p: dict, x: jax.Array, dtype) -> jax.Array:
+    if "kernel_scale" in p:
+        # int8-quantized kernel (quant/quantize.py layout): int8 weights x
+        # low-precision activations with the per-output-channel rescale
+        # applied AFTER the matmul — same fused math as quant/modules.py,
+        # so int8 TransformerLM bundles decode without a re-export
+        y = (x.astype(dtype) @ p["kernel"].astype(dtype)).astype(jnp.float32)
+        y = y * p["kernel_scale"] + p["bias"].astype(jnp.float32)
+        return y.astype(dtype)
     return (x.astype(dtype) @ p["kernel"].astype(dtype)
             + p["bias"].astype(dtype))
 
@@ -493,12 +501,27 @@ def _make_stop_check(stop_tokens: tuple):
     return lambda tok: (tok[:, None] == stops[None, :]).any(axis=-1)
 
 
-def _decode_block(module, bp: dict, x: jax.Array, k_cache: jax.Array,
-                  v_cache: jax.Array, slot, visible, dtype):
+def _quantize_cache(kc: jax.Array, vc: jax.Array) -> tuple:
+    """Convert one layer's model-dtype caches to the int8 layout:
+    (k int8, k_scale f32 (B, W, H), v int8, v_scale)."""
+    from mmlspark_tpu.quant.quantize import quantize_kv
+    kq, ks = quantize_kv(kc)
+    vq, vs = quantize_kv(vc)
+    return kq, ks, vq, vs
+
+
+def _decode_block(module, bp: dict, x: jax.Array, cache: tuple,
+                  slot, visible, dtype, cache_kind: str):
     """One TransformerBlock for a single decode token: write K/V at cache
     `slot` (shared across rows — decode slots sit after the bucket's pad
     tail), attend under the per-row `visible` mask (true-prompt slots plus
-    decode slots written so far), MLP as in `_block_with_cache`."""
+    decode slots written so far), MLP as in `_block_with_cache`.
+
+    `cache` is (k, v) for a model-dtype cache or (k_q, k_scale, v_q,
+    v_scale) for an int8 one (cache_kind 'int8'): the new token's K/V are
+    quantized per-head ON WRITE and the attention read dequantizes inside
+    `single_query_attention` — the steady step streams 1 byte per cached
+    element instead of the model dtype's 2-4."""
     from mmlspark_tpu.ops.attention import single_query_attention
     n_heads = module.n_heads
     b, s, d = x.shape
@@ -508,18 +531,33 @@ def _decode_block(module, bp: dict, x: jax.Array, k_cache: jax.Array,
     q, k, v = jnp.split(qkv, 3, axis=-1)
     shape = (b, 1, n_heads, dh)
     q, k, v = (t.reshape(shape) for t in (q, k, v))
-    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
-                                       (0, slot, 0, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
-                                       (0, slot, 0, 0))
-    o = single_query_attention(q[:, 0], k_cache, v_cache, visible)
+    if cache_kind == "int8":
+        from mmlspark_tpu.quant.quantize import quantize_kv
+        kq, ks, vq, vs = cache
+        k8, k8s = quantize_kv(k)
+        v8, v8s = quantize_kv(v)
+        kq = lax.dynamic_update_slice(kq, k8, (0, slot, 0, 0))
+        ks = lax.dynamic_update_slice(ks, k8s, (0, slot, 0))
+        vq = lax.dynamic_update_slice(vq, v8, (0, slot, 0, 0))
+        vs = lax.dynamic_update_slice(vs, v8s, (0, slot, 0))
+        o = single_query_attention(q[:, 0], kq, vq, visible,
+                                   k_scale=ks, v_scale=vs)
+        cache = (kq, ks, vq, vs)
+    else:
+        k_cache, v_cache = cache
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0))
+        o = single_query_attention(q[:, 0], k_cache, v_cache, visible)
+        cache = (k_cache, v_cache)
     x = x + _dense(bp["proj"], o.reshape(b, 1, d).astype(dtype), dtype)
     h2 = _ln(bp["LayerNorm_1"], x, dtype)
-    return x + _mlp(module, bp, h2, dtype), k_cache, v_cache
+    return x + _mlp(module, bp, h2, dtype), cache
 
 
 def _decode_step(params: dict, tok: jax.Array, pos: jax.Array, slot,
-                 caches: list, visible, module):
+                 caches: list, visible, module, cache_kind: str = "model"):
     """Logits (B, V) for one decode token per row: per-row positions `pos`
     (true prompt length + step — NOT the shared cache slot), shared write
     `slot`, per-row attention visibility."""
@@ -529,21 +567,23 @@ def _decode_step(params: dict, tok: jax.Array, pos: jax.Array, slot,
     x = emb[:, None].astype(dtype)
     new_caches = []
     for i in range(module.n_layers):
-        x, kc, vc = _decode_block(module, params[f"block{i}_w"], x,
-                                  caches[i][0], caches[i][1], slot,
-                                  visible, dtype)
-        new_caches.append((kc, vc))
+        x, cache = _decode_block(module, params[f"block{i}_w"], x,
+                                 caches[i], slot, visible, dtype,
+                                 cache_kind)
+        new_caches.append(cache)
     x = _ln(params["final_norm_w"], x, dtype)
     logits = _dense(params["lm_head"], x, dtype).astype(jnp.float32)
     return logits[:, 0], new_caches
 
 
 def _grow_cache(cache: jax.Array, window: int) -> jax.Array:
-    """Zero-extend a cache prefix to `window` slots (static shapes)."""
+    """Zero-extend a cache prefix to `window` slots (static shapes).
+    Rank-agnostic over the trailing axes: the (B, W, H, D) payloads and
+    the (B, W, H) int8-cache scale arrays grow the same way."""
     w_in = cache.shape[1]
     if w_in == window:
         return cache
-    pad = [(0, 0), (0, window - w_in), (0, 0), (0, 0)]
+    pad = [(0, 0), (0, window - w_in)] + [(0, 0)] * (cache.ndim - 2)
     return jnp.pad(cache, pad)
 
 
@@ -557,6 +597,15 @@ class DecodeEngine:
     scalars, so buckets whose windows coincide share compiled segments).
     `compiled_programs` counts the distinct shape classes built so far —
     the number the ragged-workload bench pins.
+
+    `cache_dtype='int8'` stores the KV cache quantized (per-head symmetric
+    int8, quantize-on-write; dequant inside the attention read,
+    ops/attention.py) — the steady decode step streams 1 byte per cached
+    element instead of the model dtype's 2-4, which is the win on a
+    bandwidth-bound step.  Quantizing the cache changes numerics (~1/254
+    relative per element), so near-tie greedy choices can flip; top-1
+    agreement with the model-dtype cache is test-pinned on a fixed-seed
+    model, and bench reports the agreement next to the step-time speedup.
 
     Greedy token parity with `make_generate_fn`'s full-cache per-length
     decoder is exact at float32 (test-pinned): pad slots carry exactly
@@ -573,8 +622,12 @@ class DecodeEngine:
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  stop_tokens: tuple = (),
                  chunk: int = DEFAULT_CACHE_CHUNK,
-                 min_bucket: int = DEFAULT_MIN_BUCKET):
+                 min_bucket: int = DEFAULT_MIN_BUCKET,
+                 cache_dtype: str = "model"):
         _check_generatable(module)
+        if cache_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"unknown cache_dtype '{cache_dtype}' (model | int8)")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if max_new_tokens >= module.max_len:
@@ -598,6 +651,7 @@ class DecodeEngine:
         self.stop_tokens = stop_tokens
         self.chunk = chunk
         self.min_bucket = min_bucket
+        self.cache_dtype = cache_dtype
         greedy = temperature <= 0.0
         sample = _make_sampler(temperature,
                                None if greedy else top_k,
@@ -618,13 +672,18 @@ class DecodeEngine:
                 logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
             tok = sample(last, row_keys, 0)
             done = ~live | is_stop(tok)
+            if cache_dtype == "int8":
+                # quantize-on-write at prefill granularity: the prompt's
+                # whole cache quantizes once here, decode steps quantize
+                # each new token inside _decode_block
+                caches = [_quantize_cache(kc, vc) for kc, vc in caches]
             return tok, done, caches
 
         def segment_impl(seg_len, window, variables, caches, tok, done,
                          true_len, bucket, t0, row_keys):
             params = variables["params"]
-            caches = [(_grow_cache(kc, window), _grow_cache(vc, window))
-                      for kc, vc in caches]
+            caches = [tuple(_grow_cache(c, window) for c in layer)
+                      for layer in caches]
             slots = jnp.arange(window)
 
             def step(carry, s_off):
@@ -636,7 +695,8 @@ class DecodeEngine:
                            | ((slots[None, :] >= bucket)
                               & (slots[None, :] <= slot)))
                 logits, caches = _decode_step(params, tok, pos, slot,
-                                              caches, visible, module)
+                                              caches, visible, module,
+                                              cache_dtype)
                 nxt = sample(logits, row_keys, t + 1)
                 nxt = jnp.where(done, tok, nxt)
                 return (nxt, done | is_stop(nxt), caches), tok
@@ -791,6 +851,14 @@ class TextGenerator(Transformer):
                        "rounded up to this, so steady-step cost scales "
                        "with occupancy, not max_len", ptype=int,
                        validator=lambda v: v >= 1)
+    kvCacheDtype = Param(None, "decode KV-cache storage dtype: 'int8' "
+                         "stores the cache quantized per-head "
+                         "(quantize-on-write; dequant inside the "
+                         "attention read) so the steady step streams 1 "
+                         "byte per cached element; None/'model' keeps "
+                         "the module's own dtype.  Beam search ignores "
+                         "this (full-cache model-dtype path)", ptype=str,
+                         domain=("model", "int8"))
 
     def __init__(self, bundle: Optional["ModelBundle"] = None, **kwargs):
         super().__init__(**kwargs)
@@ -840,13 +908,15 @@ class TextGenerator(Transformer):
         top_k = (self.topK or None) if sampling else None
         top_p = self.topP if sampling and self.topP < 1.0 else None
         stops = tuple(int(t) for t in (self.stopTokens or ()))
+        kv_dtype = self.kvCacheDtype or "model"
         key = ("engine", self.maxNewTokens, self.temperature, top_k, top_p,
-               stops, self.cacheChunk)
+               stops, self.cacheChunk, kv_dtype)
         if key not in self._compiled:
             self._compiled[key] = DecodeEngine(
                 self._bundle.module(), self.maxNewTokens,
                 temperature=self.temperature, top_k=top_k, top_p=top_p,
-                stop_tokens=stops, chunk=self.cacheChunk)
+                stop_tokens=stops, chunk=self.cacheChunk,
+                cache_dtype=kv_dtype)
         return self._compiled[key]
 
     def _device_variables(self):

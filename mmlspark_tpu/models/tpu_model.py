@@ -36,7 +36,15 @@ from mmlspark_tpu.parallel.prefetch import OncePerTable, Prefetcher, default_dep
 
 
 class TPUModel(Transformer):
-    """Score a table column through a compiled model over the device mesh."""
+    """Score a table column through a compiled model over the device mesh.
+
+    Quantized bundles (quant/quantize.py) score transparently: int8
+    bundles run each registered layer's fused int8-weight forward
+    (weights stay int8 in HBM; dequant is part of the compiled program),
+    bf16 bundles compute natively at bf16.  Un-quantized bundles get bf16
+    MXU rates via the `computeDtype` Param; either way the output column
+    is float32 at the table boundary.
+    """
 
     inputCol = Param(None, "input column (numeric array per row)", ptype=str)
     outputCol = Param("output", "output column for scores", ptype=str)
@@ -53,6 +61,13 @@ class TPUModel(Transformer):
         "MMLSPARK_TPU_PREFETCH_DEPTH, 0 disables overlap entirely "
         "(synchronous per-batch round trips)", ptype=int,
         validator=lambda v: v >= 0)
+    computeDtype = Param(
+        None, "compute-dtype override for the compiled forward: 'bfloat16' "
+        "runs an un-quantized float32 bundle at bf16 MXU rates, 'float32' "
+        "forces exact f32; None keeps the bundle module's own dtype.  When "
+        "an override (or a quantized bundle) is active, outputs are cast "
+        "back to float32 at the table boundary", ptype=str,
+        domain=("float32", "bfloat16"))
 
     def __init__(self, bundle: Optional[ModelBundle] = None, **kwargs):
         super().__init__(**kwargs)
@@ -115,8 +130,38 @@ class TPUModel(Transformer):
             return nodes[keys[idx]]
         return final
 
-    def _make_apply(self, mesh, variables):
+    def _quant_mode(self):
+        """'bf16' / 'int8' for a quantized bundle (quant/quantize.py
+        metadata contract), None for a plain one."""
+        if self._bundle is None:
+            return None
+        return ((self._bundle.metadata or {}).get("quantization")
+                or {}).get("mode")
+
+    def _scoring_module(self):
+        """The module the compiled forward applies: the bundle's, with its
+        compute dtype rebuilt to `computeDtype` when the Param is set (and
+        the architecture has a dtype field — custom registered models
+        without one keep their own)."""
         module = self._bundle.module()
+        cd = self.computeDtype
+        if cd is not None and "dtype" in getattr(
+                module, "__dataclass_fields__", {}):
+            from mmlspark_tpu.models.definitions import build_model
+            module = build_model(self._bundle.architecture,
+                                 {**self._bundle.config, "dtype": cd})
+        return module
+
+    def _make_apply(self, mesh, variables):
+        module = self._scoring_module()
+        quant_mode = self._quant_mode()
+        # an explicit dtype override or a quantized bundle computes in a
+        # reduced precision internally; the table boundary stays float32
+        cast_f32 = self.computeDtype is not None or quant_mode is not None
+        if quant_mode == "int8":
+            from mmlspark_tpu.quant import quantized_call
+        else:
+            from contextlib import nullcontext as quantized_call
 
         def forward(vars_, x):
             # uint8 inputs (decoded image bytes) travel the host->HBM link
@@ -126,10 +171,17 @@ class TPUModel(Transformer):
             # and friends embed them; a float cast would break Embed)
             if x.dtype == jnp.uint8:
                 x = x.astype(jnp.float32)
-            out, state = module.apply(vars_, x, mutable=["intermediates"])
+            # int8 bundles: layers whose params carry the int8 layout run
+            # their fused wrappers (quant/modules.py) — weights stay int8
+            # in HBM, dequant lives inside this compiled program
+            with quantized_call():
+                out, state = module.apply(vars_, x, mutable=["intermediates"])
             inter = state.get("intermediates", {})
             inter = {k: v for k, v in inter.items() if not isinstance(v, dict)}
-            return self._select_output(out, inter)
+            out = self._select_output(out, inter)
+            if cast_f32 and jnp.issubdtype(out.dtype, jnp.floating):
+                out = out.astype(jnp.float32)
+            return out
 
         return jax.jit(
             forward,
@@ -152,7 +204,8 @@ class TPUModel(Transformer):
             self._device_vars[mesh] = replicate_tree(
                 self._bundle.variables, mesh)
         variables = self._device_vars[mesh]
-        key = (mesh, self.outputNodeName, self.outputNodeIndex)
+        key = (mesh, self.outputNodeName, self.outputNodeIndex,
+               self.computeDtype)
         if key not in self._compiled:
             self._compiled[key] = self._make_apply(mesh, variables)
         return mesh, variables, self._compiled[key]

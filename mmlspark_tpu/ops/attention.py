@@ -62,7 +62,9 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def single_query_attention(q: jax.Array, k_cache: jax.Array,
                            v_cache: jax.Array, visible: jax.Array,
-                           scale: Optional[float] = None) -> jax.Array:
+                           scale: Optional[float] = None,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None) -> jax.Array:
     """One decode step's query against a KV-cache window.
 
     q: (B, H, D) — the single new token's query per row.
@@ -75,6 +77,14 @@ def single_query_attention(q: jax.Array, k_cache: jax.Array,
         row's true prompt and the shared decode slots; masked slots get
         exactly zero weight (NEG_INF -> exp underflows to 0.0), so layout
         padding never changes the math.
+    k_scale, v_scale: (B, L, H) float32 or None — per-(row, slot, head)
+        dequant scales for an int8-quantized cache (quant/quantize.py
+        `quantize_kv`).  The dequant is algebraically hoisted out of the
+        cache read: K's scale multiplies the score row AFTER the QK^T
+        einsum and V's folds into the softmax weights BEFORE the PV
+        einsum, so the einsums stream the raw int8 bytes — per-step HBM
+        traffic is 1 byte per cached element plus a 1/D-sized scale
+        array, never a dequantized float copy.
 
     Accumulates QK^T and PV in float32 (the single-query step is
     bandwidth-bound — the extra precision is free; same discipline as the
@@ -84,8 +94,12 @@ def single_query_attention(q: jax.Array, k_cache: jax.Array,
     scale = scale if scale is not None else d ** -0.5
     s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
                    k_cache.astype(jnp.float32)) * scale
+    if k_scale is not None:
+        s = s * k_scale.astype(jnp.float32).transpose(0, 2, 1)
     s = jnp.where(visible[:, None, :], s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        w = w * v_scale.astype(jnp.float32).transpose(0, 2, 1)
     return jnp.einsum("bhl,blhd->bhd", w, v_cache.astype(jnp.float32))
 
 

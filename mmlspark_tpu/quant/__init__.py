@@ -1,0 +1,25 @@
+"""Quantized inference: int8/bf16 weights, int8 KV cache, accuracy gates.
+
+`quantize_bundle` converts a trained ModelBundle offline; TPUModel scores
+it via the fused wrappers in `modules.py` (registered per layer class in
+utils/registry.py); `accuracy_gate` keeps every quantized arm honest.
+KV-cache quantization lives behind `TextGenerator.kvCacheDtype`
+(models/generate.py + ops/attention.py).  docs/performance.md has the
+full design.
+"""
+
+from mmlspark_tpu.quant.gate import accuracy_gate
+from mmlspark_tpu.quant.modules import (QuantConv, QuantDense,
+                                        quant_conv_apply, quant_dense_apply,
+                                        quantized_call)
+from mmlspark_tpu.quant.quantize import (dequantize_array, dequantize_bundle,
+                                         quantization_mode,
+                                         quantize_array_int8, quantize_bundle,
+                                         quantize_kv)
+
+__all__ = [
+    "QuantConv", "QuantDense", "accuracy_gate", "dequantize_array",
+    "dequantize_bundle", "quant_conv_apply", "quant_dense_apply",
+    "quantization_mode", "quantize_array_int8", "quantize_bundle",
+    "quantize_kv", "quantized_call",
+]

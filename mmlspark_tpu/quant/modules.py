@@ -1,0 +1,183 @@
+"""Quantized module wrappers: fused int8-weight forwards for flax layers.
+
+The compute half of the quant subsystem (quant/quantize.py is the storage
+half).  Two layers of API:
+
+  * **Wrapper functions** (`quant_dense_apply`, `quant_conv_apply`) — the
+    fused math for nn.Dense / nn.Conv, registered in
+    `utils/registry.py::QUANT_MODULE_WRAPPERS`.  `quantized_call()` is a
+    context manager (flax `intercept_methods`) under which ANY module
+    whose param dict carries the int8 layout ({kernel int8, kernel_scale
+    f32}) routes through its registered wrapper, while unquantized layers
+    (norms, embeddings, MoE) run their ordinary `__call__` untouched.
+    TPUModel wraps its compiled forward in it for int8 bundles, so every
+    registered architecture scores quantized without a re-export.
+  * **Standalone modules** (`QuantDense`, `QuantConv`) — the same math as
+    first-class flax modules owning int8 params, for models BUILT
+    quantized rather than converted.
+
+The fused form: y = (x_bf16 @ W_int8.astype(bf16)) * scale + bias, with
+float32 accumulation (`preferred_element_type`) and the per-output-channel
+rescale applied AFTER the matmul/conv — int8 -> bf16 conversion is exact,
+so this is numerically at least as good as dequantize-then-matmul and the
+float weight copy never exists: HBM holds 1 byte per weight, the MXU eats
+bf16, the epilogue multiply is one fused op per output channel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.utils.registry import (quant_wrapper_for,
+                                         register_quant_wrapper)
+
+
+def _ntuple(v, n: int) -> tuple:
+    if v is None:
+        v = 1
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def quant_dense_apply(mod: nn.Dense, x: jax.Array, kernel_q: jax.Array,
+                      kernel_scale: jax.Array,
+                      bias: Optional[jax.Array]) -> jax.Array:
+    """nn.Dense with int8 weights: bf16 matmul, f32 accumulate, per-output-
+    channel rescale in the epilogue."""
+    dtype = mod.dtype or jnp.bfloat16
+    y = jax.lax.dot_general(
+        x.astype(dtype), kernel_q.astype(dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    y = y * kernel_scale
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def quant_conv_apply(mod: nn.Conv, x: jax.Array, kernel_q: jax.Array,
+                     kernel_scale: jax.Array,
+                     bias: Optional[jax.Array]) -> jax.Array:
+    """nn.Conv (2-D, NHWC/HWIO) with int8 weights; conv is linear per
+    output channel, so the per-channel rescale moves after the conv
+    exactly as for Dense."""
+    n_sp = kernel_q.ndim - 2
+    if n_sp != 2:
+        raise NotImplementedError(
+            f"quantized conv supports 2-D kernels, got rank {kernel_q.ndim}")
+    if _ntuple(mod.input_dilation, n_sp) != (1,) * n_sp:
+        raise NotImplementedError(
+            "quantized conv does not support input_dilation")
+    padding = mod.padding
+    if isinstance(padding, str):
+        if padding.upper() not in ("SAME", "VALID"):
+            raise NotImplementedError(
+                f"quantized conv does not support padding='{padding}'")
+        padding = padding.upper()
+    else:
+        padding = [tuple(p) for p in padding]
+    dtype = mod.dtype or jnp.bfloat16
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, kernel_q.shape, ("NHWC", "HWIO", "NHWC"))
+    y = jax.lax.conv_general_dilated(
+        x.astype(dtype), kernel_q.astype(dtype),
+        window_strides=_ntuple(mod.strides, n_sp),
+        padding=padding,
+        rhs_dilation=_ntuple(mod.kernel_dilation, n_sp),
+        dimension_numbers=dn,
+        feature_group_count=mod.feature_group_count,
+        preferred_element_type=jnp.float32)
+    y = y * kernel_scale
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+register_quant_wrapper(nn.Dense, quant_dense_apply)
+register_quant_wrapper(nn.Conv, quant_conv_apply)
+
+
+def _quant_interceptor(next_fun, args, kwargs, context):
+    """flax method interceptor: route layers whose params carry the int8
+    layout through their registered wrapper; pass everything else through."""
+    mod = context.module
+    if context.method_name != "__call__" or mod.scope is None:
+        return next_fun(*args, **kwargs)
+    wrapper = quant_wrapper_for(type(mod))
+    if wrapper is None or not mod.scope.has_variable("params", "kernel_scale"):
+        return next_fun(*args, **kwargs)
+    kernel_q = mod.scope.get_variable("params", "kernel")
+    kernel_scale = mod.scope.get_variable("params", "kernel_scale")
+    bias = (mod.scope.get_variable("params", "bias")
+            if mod.scope.has_variable("params", "bias") else None)
+    return wrapper(mod, args[0], kernel_q, kernel_scale, bias)
+
+
+def quantized_call():
+    """Context manager: inside it, `module.apply(quantized_vars, x)` runs
+    registered layers' fused int8 forwards.  Trace-time only — wrap the
+    apply INSIDE the jitted function, so the dequant belongs to the
+    compiled program (weights stay int8 in HBM)."""
+    return nn.intercept_methods(_quant_interceptor)
+
+
+# --------------------------------------------------------------------------
+# Standalone quantized layers (for models built quantized)
+# --------------------------------------------------------------------------
+
+class QuantDense(nn.Module):
+    """A Dense layer whose stored weights ARE the int8 layout.
+
+    Params: kernel int8 (in, features), kernel_scale f32 (features,),
+    bias bf16 (features,).  Forward is `quant_dense_apply`'s math.  Init
+    gives zero weights/unit scales — real values come from
+    `quantize_array_int8` of a trained kernel.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel_q = self.param("kernel", nn.initializers.zeros,
+                              (jnp.shape(x)[-1], self.features), jnp.int8)
+        kernel_scale = self.param("kernel_scale", nn.initializers.ones,
+                                  (self.features,), jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), jnp.bfloat16)
+                if self.use_bias else None)
+        return quant_dense_apply(
+            nn.Dense(self.features, use_bias=self.use_bias, dtype=self.dtype),
+            x, kernel_q, kernel_scale, bias)
+
+
+class QuantConv(nn.Module):
+    """A 2-D Conv layer whose stored weights ARE the int8 layout (HWIO
+    kernel, per-output-channel scales); forward is `quant_conv_apply`."""
+
+    features: int
+    kernel_size: Sequence[int] = (3, 3)
+    strides: Union[int, Sequence[int]] = 1
+    padding: str = "SAME"
+    use_bias: bool = True
+    dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kshape = tuple(self.kernel_size) + (jnp.shape(x)[-1], self.features)
+        kernel_q = self.param("kernel", nn.initializers.zeros,
+                              kshape, jnp.int8)
+        kernel_scale = self.param("kernel_scale", nn.initializers.ones,
+                                  (self.features,), jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), jnp.bfloat16)
+                if self.use_bias else None)
+        return quant_conv_apply(
+            nn.Conv(self.features, tuple(self.kernel_size),
+                    strides=self.strides, padding=self.padding,
+                    use_bias=self.use_bias, dtype=self.dtype),
+            x, kernel_q, kernel_scale, bias)

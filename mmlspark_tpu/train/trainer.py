@@ -410,7 +410,7 @@ class Trainer:
             # checkpoint branches in lockstep or the job deadlocks
             flags = np.asarray([int(bool(ckpt_dir)),
                                 int(cfg.checkpoint_every_steps or 0),
-                                int(bool(resume))])
+                                int(bool(resume))], np.int64)
             all_flags = multihost_utils.process_allgather(flags)
             if not (all_flags == flags).all():
                 raise ValueError(

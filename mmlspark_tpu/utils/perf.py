@@ -30,6 +30,34 @@ _PEAK_BF16: list[tuple[str, float]] = [
 ]
 
 
+# HBM peak bandwidth (bytes/sec) per chip by device kind (public spec
+# sheets), same substring matching as _PEAK_BF16.  Used by the decode
+# bench's steady-step bandwidth model (kv_bytes_per_step / step time vs
+# this peak = hbm_bw_util): the KV-cache read is the bandwidth-bound
+# step's dominant traffic, so its utilization attributes cache-dtype wins.
+_PEAK_HBM_BPS: list[tuple[str, float]] = [
+    ("v6e", 1.64e12), ("trillium", 1.64e12),
+    ("v5p", 2.765e12),
+    ("v5 lite", 8.19e11), ("v5e", 8.19e11), ("v5litepod", 8.19e11),
+    ("v4", 1.2288e12),
+    ("v3", 9.0e11),
+    ("v2", 7.0e11),
+]
+
+
+def device_peak_hbm_bw(device: Optional[Any] = None) -> Optional[float]:
+    """HBM peak bytes/sec for `device` (default: first device); None if
+    unknown (CPU / unrecognized kinds) — callers should then omit
+    bandwidth-utilization fields rather than fabricate them."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, bw in _PEAK_HBM_BPS:
+        if key in kind:
+            return bw
+    return None
+
+
 def device_peak_flops(device: Optional[Any] = None) -> Optional[float]:
     """bf16 peak FLOP/s for `device` (default: first device); None if unknown
     (CPU / unrecognized kinds) — callers should then omit MFU rather than

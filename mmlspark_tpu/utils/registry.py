@@ -15,6 +15,7 @@ from __future__ import annotations
 import importlib
 import inspect
 import pkgutil
+from typing import Callable, Optional
 
 import mmlspark_tpu
 from mmlspark_tpu.core.pipeline import Estimator, PipelineStage, Transformer
@@ -54,6 +55,41 @@ def all_stage_classes(concrete_only: bool = True) -> list[type]:
                 continue
         out.append(cls)
     return sorted(out, key=lambda c: f"{c.__module__}.{c.__qualname__}")
+
+
+# --------------------------------------------------------------------------
+# Quantized module wrappers (quant/ subsystem).
+#
+# Maps a flax layer class to the fused quantized forward that replaces its
+# `__call__` when the layer's param dict carries int8 weights + per-channel
+# scales (`kernel` int8 with a `kernel_scale` sibling).  quant/modules.py
+# registers the nn.Dense / nn.Conv wrappers at import; custom layers opt
+# into int8 scoring by registering their own — the same open-registry
+# discipline as MODEL_REGISTRY (models/definitions.py) and the stage walk
+# above.  The lookup walks the MRO so subclasses of a registered layer
+# inherit its wrapper.
+# --------------------------------------------------------------------------
+
+QUANT_MODULE_WRAPPERS: dict[type, Callable] = {}
+
+
+def register_quant_wrapper(module_cls: type, wrapper: Callable) -> None:
+    """Register the fused int8 forward for a flax layer class.
+
+    `wrapper(module, x, kernel_q, kernel_scale, bias)` receives the BOUND
+    layer instance (its hyperparameters: strides, padding, dtype, ...), the
+    activation, the int8 kernel, the per-output-channel float32 scales, and
+    the bias (or None) — and returns what the layer's `__call__` would.
+    """
+    QUANT_MODULE_WRAPPERS[module_cls] = wrapper
+
+
+def quant_wrapper_for(module_cls: type) -> Optional[Callable]:
+    """The registered wrapper for `module_cls` (MRO-aware), or None."""
+    for cls in module_cls.__mro__:
+        if cls in QUANT_MODULE_WRAPPERS:
+            return QUANT_MODULE_WRAPPERS[cls]
+    return None
 
 
 def api_summary() -> str:

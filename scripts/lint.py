@@ -17,6 +17,12 @@ Checks, per file:
     `parallel/prefetch.py` staging pipeline, so every transfer is sharded
     deliberately and visible to the stage-timing spans; a bare device_put
     silently commits to one device and de-pipelines the loop
+  * implicit float64 promotion in hot-loop modules — `np.float64`/
+    `np.double` references, and `asarray`/`array` calls whose argument is
+    a bare python list/tuple literal (or comprehension) with no dtype:
+    numpy infers float64 from python floats, and an f64 array fed to the
+    device either doubles the transfer bytes or hits jax's silent x64
+    downcast — hot paths must pin dtypes explicitly
   * tabs in indentation
 """
 
@@ -45,6 +51,20 @@ HOT_LOOP_FILES = {
     os.path.join("mmlspark_tpu", "io", "files.py"),
 }
 
+# whole directories on the hot path: every quant/ module runs inside the
+# compiled scoring/decode programs (transfers ride parallel/bridge.py via
+# the callers, never happen here directly)
+HOT_LOOP_DIRS = {
+    os.path.join("mmlspark_tpu", "quant"),
+}
+
+
+def _in_hot_loop(path: str) -> bool:
+    norm = os.path.normpath(path)
+    if norm in HOT_LOOP_FILES:
+        return True
+    return any(norm.startswith(d + os.sep) for d in HOT_LOOP_DIRS)
+
 
 def _in_resilience(path: str) -> bool:
     return os.path.normpath(path).startswith(RESILIENCE_DIR + os.sep)
@@ -57,6 +77,29 @@ def _is_device_put_call(node: ast.Call) -> bool:
     if isinstance(fn, ast.Name):
         return fn.id == "device_put"
     return isinstance(fn, ast.Attribute) and fn.attr == "device_put"
+
+
+def _is_f64_literal_asarray(node: ast.Call) -> bool:
+    """Matches `np.asarray([...])` / `np.array((...))` / `jnp.asarray`
+    variants whose first argument is a bare list/tuple literal or
+    comprehension and which pin no dtype (second positional arg or
+    `dtype=` kw): numpy infers float64 from python floats there."""
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if name not in ("asarray", "array"):
+        return False
+    if not node.args or len(node.args) >= 2:
+        return False
+    if any(kw.arg == "dtype" for kw in node.keywords):
+        return False
+    return isinstance(node.args[0], (ast.List, ast.Tuple, ast.ListComp,
+                                     ast.GeneratorExp))
+
+
+def _is_f64_reference(node: ast.Attribute) -> bool:
+    """Matches `np.float64` / `np.double` style attribute references."""
+    return node.attr in ("float64", "double")
 
 
 def _is_urlopen_call(node: ast.Call) -> bool:
@@ -119,7 +162,7 @@ def check_file(path: str) -> list[str]:
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
 
     in_resilience = _in_resilience(path)
-    in_hot_loop = os.path.normpath(path) in HOT_LOOP_FILES
+    in_hot_loop = _in_hot_loop(path)
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None \
                 and not in_resilience:
@@ -137,6 +180,18 @@ def check_file(path: str) -> list[str]:
                 f"module — transfers go through parallel/bridge.py "
                 f"(put_sharded/shard_batch/put_tree/reshard) or "
                 f"parallel/prefetch.py staging")
+        if in_hot_loop and isinstance(node, ast.Call) \
+                and _is_f64_literal_asarray(node):
+            problems.append(
+                f"{path}:{node.lineno}: asarray/array over a bare python "
+                f"literal without a dtype in a hot-loop module — numpy "
+                f"infers float64; pin the dtype explicitly")
+        if in_hot_loop and isinstance(node, ast.Attribute) \
+                and _is_f64_reference(node):
+            problems.append(
+                f"{path}:{node.lineno}: {node.attr} in a hot-loop module "
+                f"— float64 device feeds double transfer bytes (or get "
+                f"silently downcast); use float32/bfloat16")
 
     if os.path.basename(path) != "__init__.py":
         used = used_names(tree)

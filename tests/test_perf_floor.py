@@ -91,6 +91,17 @@ def test_bench_contract_fields():
     assert result["bottleneck"] in ("host", "transfer", "compute", "drain")
     # thread-seconds accounting: the pipelined run did attribute real time
     assert result["stage_compute_s"] > 0 and result["stage_drain_s"] >= 0
+    # the int8 quantized arm ships WITH its accuracy gate (quant/gate.py):
+    # speedup fields next to the accuracy delta, same invocation, same
+    # trained weights.  The delta bound is the acceptance gate — the
+    # cifar10 convnet loses at most 0.005 accuracy to int8 PTQ
+    # (deterministic on the CPU mesh: fixed weights, fixed held-out split)
+    assert {"int8_device_images_per_sec", "int8_device_speedup",
+            "int8_accuracy", "int8_accuracy_delta",
+            "int8_agreement"} <= set(result)
+    assert result["int8_device_images_per_sec"] > 0
+    assert abs(result["int8_accuracy_delta"]) <= 0.005, result
+    assert result["int8_agreement"] >= 0.98, result
 
 
 def test_bench_decode_contract_fields():
@@ -120,6 +131,15 @@ def test_bench_decode_contract_fields():
     # generation-phase attribution rode the timed transform
     assert result["stage_prefill_s"] > 0
     assert result["stage_decode_s"] > 0
+    # int8 KV-cache arm + the steady-step bandwidth model (byte-compatible
+    # schema extension): cache wins must be attributable to bytes moved
+    assert result["int8_kv_windowed_step_ms"] > 0
+    assert result["int8_kv_greedy_agreement"] >= 0.95, result
+    assert result["kv_bytes_per_step"] > result["windowed_kv_bytes_per_step"]
+    assert (result["int8_kv_bytes_per_step"]
+            < result["windowed_kv_bytes_per_step"])
+    assert "hbm_bw_util" in result  # None off-TPU (peak unknown, never
+    # fabricated); a ratio in (0, ~1] on real HBM
 
 
 @pytest.mark.skipif(not on_tpu, reason="MFU floor needs a real TPU chip")
@@ -130,6 +150,13 @@ def test_resnet50_device_mfu_floor():
     result = bench.bench_resnet50(smoke=False)
     assert result["device_mfu"] is not None
     assert result["device_mfu"] >= 0.30, result
+    # the quantization acceptance ordering: bf16 compute (the computeDtype
+    # override over the f32-built bundle) strictly beats f32 on the
+    # MXU-bound workload in the same invocation; the int8 arm emitted a
+    # real rate alongside
+    assert (result["bf16_device_images_per_sec"]
+            > result["f32_device_images_per_sec"]), result
+    assert result["int8_device_images_per_sec"] > 0, result
 
 
 @pytest.mark.skipif(not on_tpu, reason="throughput floor needs a real TPU chip")
@@ -181,6 +208,14 @@ def test_lm_decode_throughput_floor():
     result = bench.bench_lm_decode(smoke=False)
     assert result["value"] >= 20_000, result
     assert result["windowed_step_ms"] < result["full_cache_step_ms"], result
+    # the quantized-KV acceptance ordering: int8 cache beats the
+    # model-dtype cache at the same occupancy in the same invocation (the
+    # step is bandwidth-bound; int8 halves the bytes vs bf16), and the
+    # win is honest — the agreement gate rode the same line
+    assert (result["int8_kv_windowed_step_ms"]
+            < result["windowed_step_ms"]), result
+    assert result["int8_kv_greedy_agreement"] >= 0.95, result
+    assert result["hbm_bw_util"] is not None and result["hbm_bw_util"] > 0
 
 
 @pytest.mark.skipif(not on_tpu, reason="e2e floor needs a real TPU chip")

@@ -20,7 +20,7 @@ lint:             ## AST lint (unused imports, bare except, tabs)
 bench:            ## full benchmark on the available backend
 	python bench.py
 
-bench-smoke:      ## lint + tiny-size bench incl. quantized arms (JSON contract check, no TPU needed)
+bench-smoke:      ## lint + tiny-size bench incl. quantized + telemetry-overhead arms (JSON contract check, no TPU needed)
 	python scripts/lint.py
 	python bench.py --smoke
 

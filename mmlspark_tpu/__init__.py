@@ -39,7 +39,7 @@ from mmlspark_tpu.core.pipeline import (
 )
 from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.observe import (MetricData, get_logger, pipeline_timing,
-                                  profile, stage_timing)
+                                  profile, run_telemetry, stage_timing)
 
 # persistent XLA compilation cache (MMLSPARK_TPU_COMPILATION_CACHE): wired
 # before any model compiles so warm restarts skip recompiles entirely

@@ -139,6 +139,19 @@ TEST_BUDGET_S = register(
     doc="Per-test duration alert budget in seconds (reference "
         "TestBase.scala:65 alerts at 3s; XLA compiles are ~10x that).")
 
+TELEMETRY = register(
+    "MMLSPARK_TPU_TELEMETRY", default=None,
+    doc="Telemetry kill switch: '0'/'off'/'false' makes run_telemetry() "
+        "blocks inert (no spans, no files, hot loops keep the zero-cost "
+        "fast path); unset or anything else leaves them live "
+        "(observe/telemetry.py).")
+
+TELEMETRY_DIR = register(
+    "MMLSPARK_TPU_TELEMETRY_DIR", default=None,
+    doc="Default output directory for run_telemetry(): run.jsonl event "
+        "stream + run_summary.json land here when the block passes no "
+        "dir. Unset + no explicit dir: in-memory ring only, no files.")
+
 COMPILATION_CACHE = register(
     "MMLSPARK_TPU_COMPILATION_CACHE", default=None,
     doc="Directory for JAX's persistent XLA compilation cache; when set, "

@@ -80,6 +80,8 @@ from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.models.bundle import load_bundle, save_bundle
 from mmlspark_tpu.observe.spans import active_timings, span_on
+from mmlspark_tpu.observe.telemetry import active_run
+from mmlspark_tpu.observe.trace import trace_event, trace_span
 
 NEG_INF = -1e30
 
@@ -721,6 +723,14 @@ class DecodeEngine:
         mirrors jit's specialization key, so it counts real XLA programs."""
         return len(self._programs)
 
+    def _program(self, *key) -> None:
+        """Register one executed shape class; a NEW class is a recompile
+        and surfaces as a telemetry `compile` event (zero-cost inactive)."""
+        if key not in self._programs:
+            self._programs.add(key)
+            trace_event("recompile", cat="compile", where="decode",
+                        program=str(key))
+
     def generate(self, variables, prompts, true_len, *, rng=None,
                  row_ids=None, live=None) -> np.ndarray:
         """Generate `max_new_tokens` per row: prompts (B, bucket) int32
@@ -751,33 +761,52 @@ class DecodeEngine:
         if live is None:
             live = np.ones(b, bool)
         timings = active_timings()
-        with span_on(timings, "prefill"):
-            tok, done, caches = self._prefill(variables, jnp.asarray(prompts),
-                                              jnp.asarray(true_len),
-                                              jnp.asarray(live), row_keys)
-            if timings is not None:
-                jax.block_until_ready(tok)
-        self._programs.add(("prefill", b, p))
-        segs = decode_segments(p, self.max_new_tokens, self.chunk)
-        check_exit = bool(self.stop_tokens)
-        prev_w = _round_up(p + 1, self.chunk)
-        parts = []
-        segments_run = 0
-        with span_on(timings, "decode"):
-            for t0, seg_len, window in segs:
-                if check_exit and bool(np.asarray(jax.device_get(done)).all()):
-                    break
-                caches, toks, tok, done = self._segment(
-                    seg_len, window, variables, caches, tok, done,
-                    jnp.asarray(true_len), jnp.asarray(p, jnp.int32),
-                    jnp.asarray(t0, jnp.int32), row_keys)
-                self._programs.add(("segment", b, prev_w, window, seg_len))
-                prev_w = window
-                parts.append(toks)
-                segments_run += 1
-            generated = np.concatenate(
-                [np.asarray(x) for x in parts]
-                + [np.asarray(tok)[:, None]], axis=1)
+        run = active_run()
+        with trace_span("decode.generate", cat="phase", bucket=p, batch=b,
+                        max_new_tokens=self.max_new_tokens):
+            with span_on(timings, "prefill"), \
+                    trace_span("decode.prefill", cat="bucket", bucket=p,
+                               batch=b):
+                tok, done, caches = self._prefill(
+                    variables, jnp.asarray(prompts), jnp.asarray(true_len),
+                    jnp.asarray(live), row_keys)
+                if timings is not None:
+                    jax.block_until_ready(tok)
+            self._program("prefill", b, p)
+            segs = decode_segments(p, self.max_new_tokens, self.chunk)
+            check_exit = bool(self.stop_tokens)
+            prev_w = _round_up(p + 1, self.chunk)
+            parts = []
+            segments_run = 0
+            with span_on(timings, "decode"):
+                for t0, seg_len, window in segs:
+                    if check_exit and bool(
+                            np.asarray(jax.device_get(done)).all()):
+                        trace_event("decode.early_exit", cat="decode",
+                                    at_step=t0, batch=b,
+                                    segments_skipped=len(segs)
+                                    - segments_run)
+                        break
+                    # occupancy: cache slots live after this segment over
+                    # the slots the compiled step actually attends
+                    with trace_span("decode.segment", cat="segment",
+                                    window=window, seg_len=seg_len,
+                                    step_offset=t0,
+                                    occupancy=round(
+                                        (p + t0 + seg_len) / window, 3)):
+                        caches, toks, tok, done = self._segment(
+                            seg_len, window, variables, caches, tok, done,
+                            jnp.asarray(true_len), jnp.asarray(p, jnp.int32),
+                            jnp.asarray(t0, jnp.int32), row_keys)
+                    self._program("segment", b, prev_w, window, seg_len)
+                    prev_w = window
+                    parts.append(toks)
+                    segments_run += 1
+                generated = np.concatenate(
+                    [np.asarray(x) for x in parts]
+                    + [np.asarray(tok)[:, None]], axis=1)
+        if run is not None:
+            run.gauge("decode.compiled_programs", self.compiled_programs)
         self.last_segments_run = segments_run
         self.last_new_tokens_computed = generated.shape[1]
         if generated.shape[1] < self.max_new_tokens:
@@ -1007,10 +1036,12 @@ class TextGenerator(Transformer):
         rows = [np.asarray(r, np.int32) for r in col]
         n = len(rows)
         out: list = [None] * n
-        if self.beamWidth > 0:
-            self._transform_beam(rows, out)
-        else:
-            self._transform_engine(rows, out)
+        with trace_span("generate.transform", cat="phase", rows=n,
+                        beam=self.beamWidth > 0):
+            if self.beamWidth > 0:
+                self._transform_beam(rows, out)
+            else:
+                self._transform_engine(rows, out)
         if n and len({len(r) for r in out}) == 1:
             return table.with_column(self.outputCol, np.stack(out))
         result = np.empty(n, object)

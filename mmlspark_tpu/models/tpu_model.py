@@ -29,6 +29,9 @@ from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
 from mmlspark_tpu.observe.spans import active_timings, span_on
+from mmlspark_tpu.observe.telemetry import active_run
+from mmlspark_tpu.observe.trace import (active_tracer, current_span_id,
+                                        span_on_tracer)
 from mmlspark_tpu.parallel.bridge import (pad_to_multiple, put_sharded,
                                           replicate_tree, reshard)
 from mmlspark_tpu.parallel.mesh import batch_sharding, best_mesh, replicated
@@ -75,12 +78,16 @@ class TPUModel(Transformer):
         self._mesh = None
         self._device_vars: dict[Any, Any] = {}   # per-mesh replicated weights
         self._compiled: dict[tuple, Any] = {}    # per-(mesh, node) apply fns
+        self._seen_shapes: set = set()           # batch shape classes scored
+        # (jit specializes per shape class: a NEW key here is a recompile,
+        # surfaced as a telemetry `compile` event and counted as a gauge)
 
     # -- model/mesh wiring ---------------------------------------------
     def set_bundle(self, bundle: ModelBundle) -> "TPUModel":
         self._bundle = bundle
         self._device_vars.clear()
         self._compiled.clear()
+        self._seen_shapes.clear()
         return self
 
     @property
@@ -91,6 +98,7 @@ class TPUModel(Transformer):
         self._mesh = mesh
         self._device_vars.clear()
         self._compiled.clear()
+        self._seen_shapes.clear()
         return self
 
     def _get_mesh(self):
@@ -268,6 +276,8 @@ class TPUModel(Transformer):
         # from the HOST column's length, never the device shape.
         window = self._prefetch_depth()
         timings = active_timings()
+        tracer = active_tracer()
+        run = active_run()
         n = len(col)
         in_flight: list[tuple[Any, int]] = []
         results: list[np.ndarray] = []
@@ -287,8 +297,22 @@ class TPUModel(Transformer):
                         + [(0, 0)] * (chunk.ndim - 1)
                     chunk = jnp.pad(chunk, pad)
                 dev = reshard(chunk, sharding)  # on-device reshard
-            with span_on(timings, "compute"):
-                out = apply_fn(variables, dev)
+            if tracer is None:
+                with span_on(timings, "compute"):
+                    out = apply_fn(variables, dev)
+            else:
+                key = f"{tuple(dev.shape)}:{dev.dtype}"
+                if key not in self._seen_shapes:
+                    self._seen_shapes.add(key)
+                    tracer.event("recompile", parent=current_span_id(),
+                                 cat="compile", where="tpu_model",
+                                 shape_class=key)
+                with tracer.span("score.batch",
+                                 parent=current_span_id(), cat="batch",
+                                 shape_class=key, rows=valid,
+                                 device_cached=True), \
+                        span_on(timings, "compute"):
+                    out = apply_fn(variables, dev)
             try:
                 out.copy_to_host_async()
             except (AttributeError, RuntimeError):
@@ -296,6 +320,9 @@ class TPUModel(Transformer):
             in_flight.append((out, valid))
             drain(window)
         drain(0)
+        if run is not None:
+            run.gauge("tpu_model.compiled_programs", len(self._compiled))
+            run.gauge("tpu_model.shape_classes", len(self._seen_shapes))
         if results:
             result = np.concatenate(results, axis=0)
         else:
@@ -345,6 +372,14 @@ class TPUModel(Transformer):
         sharding = batch_sharding(mesh)
         depth = self._prefetch_depth()
         timings = active_timings()  # captured HERE: workers have no context
+        # telemetry handles, captured by the same closure rule: the tracer
+        # and the phase span id travel into the staging workers by value
+        tracer = active_tracer()
+        run = active_run()
+        score_span = tracer.span(
+            "score.transform_batches", parent=current_span_id(),
+            cat="phase", batch_size=bs) if tracer is not None else None
+        score_id = score_span.span_id if score_span is not None else None
         in_flight: list[tuple[Any, int, dict]] = []
         ready: list[DataTable] = []
         pending: list[dict] = []
@@ -372,11 +407,13 @@ class TPUModel(Transformer):
                 rec["parts"] = [self._empty_output(
                     column.get(), variables, apply_fn, bs)]
                 return ("empty", rec, None, 0)
-            with span_on(timings, "host"):
-                col = column.get()
-                chunk, valid = pad_to_multiple(col[start:start + bs], bs)
-            with span_on(timings, "transfer"):
-                dev = put_sharded(chunk, sharding)
+            with span_on_tracer(tracer, "score.stage", parent=score_id,
+                                cat="stage"):
+                with span_on(timings, "host"):
+                    col = column.get()
+                    chunk, valid = pad_to_multiple(col[start:start + bs], bs)
+                with span_on(timings, "transfer"):
+                    dev = put_sharded(chunk, sharding)
             return ("batch", rec, dev, valid)
 
         def drain(limit: int):
@@ -407,8 +444,25 @@ class TPUModel(Transformer):
                     # cross-table pipeline)
                     drain(len(in_flight))
                 else:
-                    with span_on(timings, "compute"):
-                        out = apply_fn(variables, dev)
+                    if tracer is None:
+                        with span_on(timings, "compute"):
+                            out = apply_fn(variables, dev)
+                    else:
+                        # the span walls the DISPATCH (async — no sync is
+                        # added), which is where jit pays compilation: a
+                        # new shape class shows as a long batch span plus
+                        # an explicit `compile` event
+                        key = f"{tuple(dev.shape)}:{dev.dtype}"
+                        if key not in self._seen_shapes:
+                            self._seen_shapes.add(key)
+                            tracer.event("recompile", parent=score_id,
+                                         cat="compile", where="tpu_model",
+                                         shape_class=key)
+                        with tracer.span("score.batch", parent=score_id,
+                                         cat="batch", shape_class=key,
+                                         rows=valid), \
+                                span_on(timings, "compute"):
+                            out = apply_fn(variables, dev)
                     try:
                         out.copy_to_host_async()
                     except (AttributeError, RuntimeError):
@@ -422,6 +476,13 @@ class TPUModel(Transformer):
                 yield ready.pop(0)
         finally:
             staged.close()
+            if score_span is not None:
+                score_span.finish()
+            if run is not None:
+                run.gauge("tpu_model.compiled_programs",
+                          len(self._compiled))
+                run.gauge("tpu_model.shape_classes",
+                          len(self._seen_shapes))
 
     def _transform_multihost(self, col, mesh, variables, apply_fn,
                              bs: int) -> np.ndarray:
@@ -517,3 +578,4 @@ class TPUModel(Transformer):
         self._mesh = None
         self._device_vars = {}
         self._compiled = {}
+        self._seen_shapes = set()

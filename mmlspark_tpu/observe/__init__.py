@@ -1,19 +1,33 @@
-"""Observability: logger factory, typed metric contract, stage timers, and
-device profiling (reference Logging.scala:14-23 + Metrics.scala:37-47 +
-TestBase.scala:138-153; the profiler is TPU-native headroom)."""
+"""Observability: logger factory, typed metric contract, stage timers,
+device profiling, and the unified telemetry subsystem — structured run
+traces (trace.py), the run_telemetry run record (telemetry.py),
+Prometheus export (export.py), and the run-report diagnostic (report.py).
+Reference Logging.scala:14-23 + Metrics.scala:37-47 + TestBase.scala:
+138-153; everything past the loggers is TPU-native headroom."""
 
 from mmlspark_tpu.observe.logging import LOG_ROOT, get_logger
 from mmlspark_tpu.observe.metrics import (MetricData, counters_metric_data,
                                           counters_snapshot, get_counter,
                                           inc_counter, reset_counters)
+from mmlspark_tpu.observe.export import (prometheus_text, serve_metrics,
+                                         write_metrics)
 from mmlspark_tpu.observe.profiler import annotate, profile
 from mmlspark_tpu.observe.spans import (PipelineTimings, active_timings,
                                         pipeline_timing, span_on)
+from mmlspark_tpu.observe.telemetry import (RunTelemetry, active_run,
+                                            run_telemetry)
 from mmlspark_tpu.observe.timing import (StageTimings, instrument_stage_method,
                                          stage_timing)
+from mmlspark_tpu.observe.trace import (Span, Tracer, active_tracer,
+                                        current_span_id, trace_event,
+                                        trace_span)
 
 __all__ = ["LOG_ROOT", "get_logger", "MetricData", "annotate", "profile",
            "StageTimings", "instrument_stage_method", "stage_timing",
            "PipelineTimings", "active_timings", "pipeline_timing", "span_on",
            "inc_counter", "get_counter", "counters_snapshot",
-           "reset_counters", "counters_metric_data"]
+           "reset_counters", "counters_metric_data",
+           "Span", "Tracer", "active_tracer", "current_span_id",
+           "trace_event", "trace_span",
+           "RunTelemetry", "active_run", "run_telemetry",
+           "prometheus_text", "serve_metrics", "write_metrics"]

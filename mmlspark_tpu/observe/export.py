@@ -1,0 +1,143 @@
+"""Metrics export: Prometheus text exposition over counters/gauges/spans.
+
+The TensorFlow system paper (PAPERS.md) credits built-in metrics — not
+bolt-on profiling — for production viability; this is the pull surface:
+`prometheus_text()` renders the process counters (observe/metrics.py),
+the active run's gauges, its per-span-name aggregates, and its stage
+attribution as Prometheus text exposition format (version 0.0.4), so a
+run is scrapeable by any standard collector.
+
+Two pull transports, both dependency-free:
+
+  * `write_metrics(path)` — file pull (node_exporter textfile-collector
+    style: a cron/sidecar ships the file);
+  * `serve_metrics(port)` — a stdlib-only `http.server` thread answering
+    GET /metrics; returns the server (its bound port at
+    `server.server_address[1]`, stop with `server.shutdown()`).
+
+Metric naming: every name is prefixed `mmlspark_tpu_`, sanitized to the
+Prometheus charset, counters suffixed `_total`.  Counter values are the
+process-wide ABSOLUTE tallies (Prometheus counters are cumulative by
+contract; rate() handles resets) — per-run deltas live in
+run_summary.json, not here.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Optional
+
+from mmlspark_tpu.observe.metrics import counters_snapshot
+from mmlspark_tpu.observe.telemetry import RunTelemetry, active_run
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+PREFIX = "mmlspark_tpu"
+
+
+def _metric_name(name: str, suffix: str = "") -> str:
+    base = _NAME_RE.sub("_", name.strip())
+    if base and base[0].isdigit():
+        base = "_" + base
+    return f"{PREFIX}_{base}{suffix}"
+
+
+def _fmt(value: float) -> str:
+    value = float(value)
+    return repr(int(value)) if value == int(value) else repr(value)
+
+
+def prometheus_text(run: Optional[RunTelemetry] = None) -> str:
+    """The exposition document.  `run` defaults to the ambient
+    run_telemetry block; with no run active, counters alone are exposed
+    (they are process-wide and always meaningful)."""
+    run = run if run is not None else active_run()
+    lines: list[str] = []
+
+    counters = counters_snapshot()
+    for name in sorted(counters):
+        metric = _metric_name(name, "_total")
+        lines.append(f"# HELP {metric} mmlspark_tpu process counter "
+                     f"{name!r}")
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {_fmt(counters[name])}")
+
+    if run is not None and run.live:
+        for name, g in sorted(run.gauges().items()):
+            metric = _metric_name(name)
+            lines.append(f"# HELP {metric} mmlspark_tpu run gauge "
+                         f"{name!r} (last sample; _max variant below)")
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {_fmt(g['last'])}")
+            lines.append(f"{metric}_max {_fmt(g['max'])}")
+
+        agg = run.tracer.span_aggregates()
+        if agg:
+            secs = _metric_name("span_seconds", "_total")
+            cnt = _metric_name("span", "_total")
+            lines.append(f"# HELP {secs} total seconds per span name")
+            lines.append(f"# TYPE {secs} counter")
+            for name in sorted(agg):
+                lines.append(f'{secs}{{name="{name}"}} '
+                             f"{_fmt(agg[name]['total_s'])}")
+            lines.append(f"# HELP {cnt} span count per span name")
+            lines.append(f"# TYPE {cnt} counter")
+            for name in sorted(agg):
+                lines.append(f'{cnt}{{name="{name}"}} '
+                             f"{_fmt(agg[name]['count'])}")
+
+        if run.timings.seconds:
+            stage = _metric_name("stage_seconds", "_total")
+            lines.append(f"# HELP {stage} thread-seconds per pipeline "
+                         f"stage (observe/spans.py)")
+            lines.append(f"# TYPE {stage} counter")
+            for name in sorted(run.timings.seconds):
+                lines.append(f'{stage}{{stage="{name}"}} '
+                             f"{_fmt(run.timings.seconds[name])}")
+
+    return "\n".join(lines) + "\n"
+
+
+def write_metrics(path: str, run: Optional[RunTelemetry] = None) -> str:
+    """File-pull exposition (textfile-collector style)."""
+    with open(path, "w") as f:
+        f.write(prometheus_text(run))
+    return path
+
+
+def serve_metrics(port: int = 0, host: str = "127.0.0.1",
+                  run: Optional[RunTelemetry] = None):
+    """Serve GET /metrics on a daemon thread (stdlib http.server only).
+
+    `run` is captured HERE, on the caller's thread: the server thread
+    never sees the caller's contextvars (the same capture-by-closure rule
+    as spans.py), so the ambient run must be bound at call time.
+    Returns the HTTPServer; port 0 binds an ephemeral port (read it back
+    from `server.server_address[1]`), `server.shutdown()` stops it.
+    """
+    import http.server
+
+    run = run if run is not None else active_run()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = prometheus_text(run).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            from mmlspark_tpu.observe.logging import get_logger
+            get_logger("observe.export").debug(fmt, *args)
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="mmlspark-metrics")
+    thread.start()
+    return server

@@ -9,7 +9,9 @@ wraps jax.profiler.trace: the dump is a TensorBoard/Perfetto trace showing
 host transfer vs MXU occupancy per step.  `annotate(name)` adds a named span
 inside an active trace (jax.profiler.TraceAnnotation) around host-side code
 so framework phases (batching, padding, fetch) are visible between device
-ops.
+ops.  The framework-side run record (`run_telemetry`'s trace.json,
+observe/telemetry.py) uses the same Perfetto timeline idiom, so the two
+dumps load side by side.
 """
 
 from __future__ import annotations
@@ -17,6 +19,8 @@ from __future__ import annotations
 import contextlib
 
 import jax
+
+from mmlspark_tpu.observe.logging import get_logger
 
 
 @contextlib.contextmanager
@@ -33,12 +37,30 @@ def profile(log_dir: str, *, host_tracer_level: int = 2):
             options = jax.profiler.ProfileOptions()
             options.host_tracer_level = host_tracer_level
             kwargs["profiler_options"] = options
-    except Exception:
-        pass  # older jax: no options support
+    except Exception as exc:
+        # a REAL probe failure (import error, renamed API) must be visible
+        # — a silently downgraded trace reads as "the chip was idle" and
+        # sends the investigation the wrong way.  The trace itself still
+        # runs: options are an enhancement, not a requirement.
+        get_logger("observe").warning(
+            "jax.profiler signature probe failed (%r); tracing without "
+            "profiler_options (host_tracer_level=%d not applied)",
+            exc, host_tracer_level)
     with jax.profiler.trace(log_dir, **kwargs):
         yield log_dir
 
 
 def annotate(name: str):
-    """Named host-side span, visible inside an active trace."""
-    return jax.profiler.TraceAnnotation(name)
+    """Named host-side span, visible inside an active trace.
+
+    Off-TPU builds (or jax versions) without a working TraceAnnotation
+    degrade to an inert context manager — caller code stays unconditional
+    — and the downgrade is logged once per call site's first failure
+    rather than silently swallowed."""
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception as exc:
+        get_logger("observe").debug(
+            "profiler annotation unavailable off-TPU (%r); %r is a no-op",
+            exc, name)
+        return contextlib.nullcontext()

@@ -1,0 +1,181 @@
+"""Run-report diagnostic: replay a run.jsonl into the human answer to
+"what did that run actually do, and what bounded it".
+
+    python -m mmlspark_tpu.observe.report <run_dir_or_run.jsonl> [--top N]
+
+Sections (each a structured field of `build_report`, rendered by
+`render_report` — so tools can consume the dict while humans read the
+text):
+
+  * **stage attribution + bottleneck verdict** — the run's thread-seconds
+    per pipeline phase, replayed through the SAME PipelineTimings verdict
+    logic the live `pipeline_timing()` block uses (observe/spans.py), so
+    the offline answer can never drift from the online one;
+  * **top-N slowest steps** — per-step/batch/segment spans ranked by
+    duration, with their attrs (the "what did step 1234 do" query);
+  * **recompiles** — `cat="compile"` events: every new shape class /
+    compiled program the run paid for, in order;
+  * **resilience timeline** — retries, breaker transitions, chaos
+    injections, preemption/resume, ordered by timestamp;
+  * **counters** — the run's counter deltas.
+
+This module is the CLI whitelisted for raw print() output
+(scripts/lint.py): everything else in mmlspark_tpu/ routes through
+observe.logging.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Iterable, Optional
+
+from mmlspark_tpu.observe.spans import PipelineTimings
+
+# span cats ranked in the slowest-steps table: the per-item work units
+STEP_CATS = ("step", "batch", "segment", "bucket")
+
+
+def load_run(path: str) -> list[dict]:
+    """Parse a run.jsonl (or a run directory containing one).  Torn tails
+    are expected — a preempted run stops mid-line — so undecodable lines
+    are skipped, never raised on (the checkpoint-validation posture)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "run.jsonl")
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail of a killed run
+    return events
+
+
+def _stage_timings(events: Iterable[dict]) -> Optional[PipelineTimings]:
+    """Rebuild the run's PipelineTimings from its stage_timings event so
+    the bottleneck verdict is computed by spans.py's own logic."""
+    seconds = None
+    for ev in events:
+        if ev.get("type") == "stage_timings":
+            seconds = ev.get("seconds", {})
+    if seconds is None:
+        return None
+    timings = PipelineTimings()
+    timings.seconds.update({k: float(v) for k, v in seconds.items()})
+    return timings
+
+
+def build_report(events: list[dict], top: int = 5) -> dict:
+    """The structured report over a parsed event list."""
+    spans = [e for e in events if e.get("type") == "span"]
+    instants = [e for e in events if e.get("type") == "event"]
+    counters = {}
+    wall_s = None
+    for ev in events:
+        if ev.get("type") == "counters":
+            counters = ev.get("deltas", {})
+        elif ev.get("type") == "run_end":
+            wall_s = ev.get("wall_s")
+    if wall_s is None and (spans or instants):  # torn run: best effort
+        wall_s = max(e["ts"] + e.get("dur", 0.0)
+                     for e in spans + instants)
+
+    timings = _stage_timings(events)
+    steps = sorted((s for s in spans if s.get("cat") in STEP_CATS),
+                   key=lambda s: -s["dur"])
+    recompiles = [e for e in instants if e.get("cat") == "compile"]
+    resilience = sorted((e for e in instants + spans
+                         if e.get("cat") == "resilience"),
+                        key=lambda e: e["ts"])
+    from mmlspark_tpu.observe.trace import aggregate_spans
+    return {
+        "wall_s": wall_s,
+        "events": len(events),
+        "stage_seconds": dict(timings.seconds) if timings else {},
+        "bottleneck": timings.bottleneck() if timings else None,
+        "span_aggregates": aggregate_spans(spans),
+        "slowest_steps": steps[:top],
+        "recompiles": recompiles,
+        "resilience": resilience,
+        "counters": counters,
+    }
+
+
+def _attrs_str(attrs: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in attrs.items())
+
+
+def render_report(report: dict) -> str:
+    """The human text for a built report."""
+    lines = ["== mmlspark_tpu run report =="]
+    if report["wall_s"] is not None:
+        lines.append(f"wall: {report['wall_s']:.3f}s over "
+                     f"{report['events']} events")
+
+    lines.append("")
+    lines.append("-- stage attribution (thread-seconds) --")
+    if report["stage_seconds"]:
+        total = sum(report["stage_seconds"].values()) or 1.0
+        for stage, s in sorted(report["stage_seconds"].items(),
+                               key=lambda kv: -kv[1]):
+            lines.append(f"  {stage:<10} {s:9.4f}s  {100 * s / total:5.1f}%")
+        lines.append(f"  bottleneck verdict: {report['bottleneck']}")
+    else:
+        lines.append("  (no stage timings recorded)")
+
+    lines.append("")
+    lines.append(f"-- top {len(report['slowest_steps'])} slowest steps --")
+    for s in report["slowest_steps"]:
+        lines.append(f"  {s['dur'] * 1e3:9.2f}ms  {s['name']:<16} "
+                     f"@{s['ts']:.3f}s  {_attrs_str(s.get('attrs', {}))}")
+    if not report["slowest_steps"]:
+        lines.append("  (no step/batch/segment spans)")
+
+    lines.append("")
+    lines.append(f"-- recompiles ({len(report['recompiles'])}) --")
+    for e in report["recompiles"]:
+        lines.append(f"  @{e['ts']:.3f}s {e['name']} "
+                     f"{_attrs_str(e.get('attrs', {}))}")
+    if not report["recompiles"]:
+        lines.append("  (none recorded)")
+
+    lines.append("")
+    lines.append(f"-- resilience timeline ({len(report['resilience'])}) --")
+    for e in report["resilience"]:
+        lines.append(f"  @{e['ts']:.3f}s {e['name']} "
+                     f"{_attrs_str(e.get('attrs', {}))}")
+    if not report["resilience"]:
+        lines.append("  (no retries / preemptions / chaos)")
+
+    if report["counters"]:
+        lines.append("")
+        lines.append("-- counter deltas --")
+        for name in sorted(report["counters"]):
+            lines.append(f"  {name:<32} {report['counters'][name]:g}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mmlspark_tpu.observe.report",
+        description="Replay a run.jsonl into the human run diagnostic.")
+    parser.add_argument("run", help="run directory or run.jsonl path")
+    parser.add_argument("--top", type=int, default=5,
+                        help="slowest steps to list (default 5)")
+    args = parser.parse_args(argv)
+    events = load_run(args.run)
+    if not events:
+        print(f"no events in {args.run}")
+        return 1
+    print(render_report(build_report(events, top=args.top)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,14 +94,20 @@ class PipelineTimings:
 
 
 @contextlib.contextmanager
-def pipeline_timing() -> Iterator[PipelineTimings]:
+def pipeline_timing(timings: Optional[PipelineTimings] = None
+                    ) -> Iterator[PipelineTimings]:
     """Collect per-phase spans for the dynamic extent of the block.
 
         with pipeline_timing() as spans:
             model.transform(table)
         print(spans.summary())   # {'stage_host_s': ..., 'bottleneck': ...}
+
+    `timings` installs an EXISTING collector instead of a fresh one —
+    how run_telemetry (observe/telemetry.py) owns the run's stage
+    attribution while the hot loops keep recording through the same
+    `active_timings()` fast path.
     """
-    timings = PipelineTimings()
+    timings = timings if timings is not None else PipelineTimings()
     token = _collector.set(timings)
     try:
         yield timings

@@ -108,8 +108,13 @@ def run_collective(op: str, fn: Callable[[], Any],
     wedging forever inside an opaque XLA/DCN wait.  The abandoned worker
     thread is daemonic — the process is expected to exit on this error.
     """
+    from mmlspark_tpu.observe.trace import trace_event, trace_span
     if jax.process_count() == 1:
-        return fn()
+        # still spanned: collective call sites (checkpoint gather/broadcast,
+        # preempt sync) keep their durations in the run record even when
+        # the op degenerates to a local call
+        with trace_span(f"collective.{op}", cat="collective", op=op):
+            return fn()
     timeout = timeout_s if timeout_s is not None else collective_timeout_s()
     result: dict[str, Any] = {}
     error: list[BaseException] = []
@@ -123,10 +128,14 @@ def run_collective(op: str, fn: Callable[[], Any],
     worker = threading.Thread(target=run, daemon=True,
                               name=f"collective-{op}")
     worker.start()
-    worker.join(timeout)
+    with trace_span(f"collective.{op}", cat="collective", op=op,
+                    timeout_s=timeout):
+        worker.join(timeout)
     if worker.is_alive():
         from mmlspark_tpu.observe.metrics import inc_counter
         inc_counter("collective.timeouts")
+        trace_event("collective.timeout", cat="resilience", op=op,
+                    timeout_s=timeout)
         raise CollectiveTimeoutError(op, timeout, present=[])
     if error:
         raise error[0]

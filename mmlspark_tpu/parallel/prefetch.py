@@ -36,6 +36,7 @@ modules, so every host->HBM transfer goes through one of these two files.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Optional
@@ -87,6 +88,15 @@ class Prefetcher:
         self._executor: Optional[ThreadPoolExecutor] = None
         self._source_error: Optional[BaseException] = None
         self._exhausted = False
+        # telemetry (observe/telemetry.py): the run handle is captured at
+        # construction — on the CONSUMER thread — because workers never
+        # see its contextvar.  When a run is active, each delivery gauges
+        # the staged-queue depth and the cumulative time the consumer
+        # spent BLOCKED on an unfinished staging future (stall = the
+        # pipeline failing to hide host/transfer work).
+        from mmlspark_tpu.observe.telemetry import active_run
+        self._run = active_run()
+        self.stall_s = 0.0
 
     # -- iteration ------------------------------------------------------
     def __iter__(self) -> Iterator:
@@ -112,7 +122,19 @@ class Prefetcher:
                     raise err
                 self.close()
                 raise StopIteration
-            result = self._pending.popleft().result()
+            fut = self._pending.popleft()
+            if self._run is None:
+                result = fut.result()
+            else:
+                stalled = not fut.done()
+                t0 = time.perf_counter() if stalled else 0.0
+                result = fut.result()
+                if stalled:
+                    self.stall_s += time.perf_counter() - t0
+                self._run.gauge(f"prefetch.{self._name}.depth",
+                                len(self._pending))
+                self._run.gauge(f"prefetch.{self._name}.stall_s",
+                                round(self.stall_s, 6))
             self._top_up()  # refill the window before handing control back
             return result
         except StopIteration:

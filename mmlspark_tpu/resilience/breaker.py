@@ -24,6 +24,7 @@ from typing import Optional
 from mmlspark_tpu import config
 from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.observe.trace import trace_event
 from mmlspark_tpu.resilience.clock import Clock, get_clock
 
 BREAKER_THRESHOLD = config.register(
@@ -92,6 +93,9 @@ class CircuitBreaker:
             if self.state == OPEN and waited >= self.reset_s:
                 self.state = HALF_OPEN
                 inc_counter("breaker.half_open")
+                trace_event("breaker.half_open", cat="resilience",
+                            endpoint=self.endpoint,
+                            waited_s=round(waited, 3))
                 get_logger("resilience").info(
                     "breaker %s: half-open probe after %.1fs",
                     self.endpoint, waited)
@@ -100,8 +104,12 @@ class CircuitBreaker:
                 # a probe is already in flight; refuse concurrent callers
                 # (they would defeat the single-probe semantics)
                 inc_counter("breaker.refused")
+                trace_event("breaker.refused", cat="resilience",
+                            endpoint=self.endpoint, state=HALF_OPEN)
                 raise CircuitOpenError(self.endpoint, self.reset_s)
             inc_counter("breaker.refused")
+            trace_event("breaker.refused", cat="resilience",
+                        endpoint=self.endpoint, state=OPEN)
             raise CircuitOpenError(self.endpoint,
                                    self.reset_s - waited)
 
@@ -109,6 +117,8 @@ class CircuitBreaker:
         with self._lock:
             if self.state != CLOSED:
                 inc_counter("breaker.closed")
+                trace_event("breaker.closed", cat="resilience",
+                            endpoint=self.endpoint, outcome="probe_ok")
                 get_logger("resilience").info(
                     "breaker %s: closed after successful probe",
                     self.endpoint)
@@ -128,6 +138,10 @@ class CircuitBreaker:
                 self.state = OPEN
                 self._opened_at = self._now()
                 inc_counter("breaker.opened")
+                trace_event("breaker.opened", cat="resilience",
+                            endpoint=self.endpoint,
+                            failures=self.consecutive_failures,
+                            error=type(exc).__name__ if exc else None)
                 get_logger("resilience").warning(
                     "breaker %s: OPEN after %d consecutive failures "
                     "(last: %r); cooling down %.1fs", self.endpoint,

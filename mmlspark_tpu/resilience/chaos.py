@@ -30,6 +30,7 @@ from typing import Optional
 from mmlspark_tpu import config
 from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.observe.trace import trace_event
 from mmlspark_tpu.resilience.clock import get_clock
 
 CHAOS_SEED = config.register(
@@ -95,10 +96,13 @@ class ChaosInjector:
         """Called before a network fetch; may raise an injected fault."""
         if self.net_error_rate and self._rng.random() < self.net_error_rate:
             inc_counter("chaos.net_errors")
+            trace_event("chaos.net_error", cat="resilience", url=url)
             raise InjectedNetworkError(
                 f"chaos: injected connection error for {url}")
         if self.stall_rate and self._rng.random() < self.stall_rate:
             inc_counter("chaos.stalls")
+            trace_event("chaos.stall", cat="resilience", url=url,
+                        stall_s=self.stall_s)
             get_clock().sleep(self.stall_s)  # virtual under tests
             raise InjectedStallError(
                 f"chaos: injected {self.stall_s:.0f}s stalled read for {url}")
@@ -111,6 +115,7 @@ class ChaosInjector:
         with open(path, "r+b") as f:
             f.truncate(max(1, int(size * keep_fraction)))
         inc_counter("chaos.torn_files")
+        trace_event("chaos.torn_file", cat="resilience", path=path)
         get_logger("resilience").warning("chaos: tore file %s", path)
 
     def maybe_tear_checkpoint(self, path: str) -> bool:
@@ -130,6 +135,7 @@ class ChaosInjector:
                 and step >= self.preempt_at_step):
             self._preempt_fired = True
             inc_counter("chaos.preemptions")
+            trace_event("chaos.preemption", cat="resilience", step=step)
             get_logger("resilience").warning(
                 "chaos: raising simulated SIGTERM at step %d", step)
             signal.raise_signal(signal.SIGTERM)

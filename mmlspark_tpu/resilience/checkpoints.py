@@ -30,6 +30,7 @@ from typing import Optional
 from mmlspark_tpu import config
 from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.observe.trace import trace_event, trace_span
 
 CKPT_KEEP = config.register(
     "MMLSPARK_TPU_CKPT_KEEP", 3,
@@ -69,19 +70,22 @@ def write_checkpoint(ckpt_dir: str, step: int, data: bytes,
     rename lands and LATEST moves only after both, so every state a crash
     can leave behind is either ignorable (orphan tmp/sidecar) or valid.
     """
-    os.makedirs(ckpt_dir, exist_ok=True)
-    name = checkpoint_name(step)
-    path = os.path.join(ckpt_dir, name)
-    _atomic_write(path + ".sha256", _sha256(data).encode())
-    _atomic_write(path, data)
-    # chaos may tear the file we just wrote (simulating partial upload /
-    # crash-adjacent corruption); restore-side validation must absorb it
-    from mmlspark_tpu.resilience.chaos import get_injector
-    get_injector().maybe_tear_checkpoint(path)
-    _atomic_write(os.path.join(ckpt_dir, LATEST), name.encode())
-    inc_counter("checkpoint.writes")
-    prune(ckpt_dir, keep if keep is not None else int(CKPT_KEEP.current()))
-    return path
+    with trace_span("checkpoint.write", cat="checkpoint", step=step,
+                    bytes=len(data)):
+        os.makedirs(ckpt_dir, exist_ok=True)
+        name = checkpoint_name(step)
+        path = os.path.join(ckpt_dir, name)
+        _atomic_write(path + ".sha256", _sha256(data).encode())
+        _atomic_write(path, data)
+        # chaos may tear the file we just wrote (simulating partial upload
+        # / crash-adjacent corruption); restore-side validation absorbs it
+        from mmlspark_tpu.resilience.chaos import get_injector
+        get_injector().maybe_tear_checkpoint(path)
+        _atomic_write(os.path.join(ckpt_dir, LATEST), name.encode())
+        inc_counter("checkpoint.writes")
+        prune(ckpt_dir,
+              keep if keep is not None else int(CKPT_KEEP.current()))
+        return path
 
 
 def list_checkpoints(ckpt_dir: str) -> list[tuple[int, str]]:
@@ -116,28 +120,31 @@ def latest_valid_checkpoint(ckpt_dir: str) -> Optional[str]:
     rotation checkpoints newest-first, then the legacy single-file layout.
     Invalid candidates are skipped with a warning, not raised on.
     """
-    candidates: list[str] = []
-    pointer = os.path.join(ckpt_dir, LATEST)
-    if os.path.exists(pointer):
-        with open(pointer) as f:
-            candidates.append(os.path.join(ckpt_dir, f.read().strip()))
-    candidates += [p for _, p in list_checkpoints(ckpt_dir)]
-    seen = set()
-    log = get_logger("resilience")
-    for path in candidates:
-        if path in seen:
-            continue
-        seen.add(path)
-        if is_valid(path):
-            return path
-        if os.path.exists(path):
-            inc_counter("checkpoint.skipped_corrupt")
-            log.warning("skipping corrupt/torn checkpoint %s "
-                        "(checksum mismatch)", path)
-    legacy = os.path.join(ckpt_dir, _LEGACY)
-    if os.path.exists(legacy):
-        return legacy  # pre-rotation layout: no sidecar to validate
-    return None
+    with trace_span("checkpoint.validate", cat="checkpoint"):
+        candidates: list[str] = []
+        pointer = os.path.join(ckpt_dir, LATEST)
+        if os.path.exists(pointer):
+            with open(pointer) as f:
+                candidates.append(os.path.join(ckpt_dir, f.read().strip()))
+        candidates += [p for _, p in list_checkpoints(ckpt_dir)]
+        seen = set()
+        log = get_logger("resilience")
+        for path in candidates:
+            if path in seen:
+                continue
+            seen.add(path)
+            if is_valid(path):
+                return path
+            if os.path.exists(path):
+                inc_counter("checkpoint.skipped_corrupt")
+                trace_event("checkpoint.skipped_corrupt", cat="resilience",
+                            path=path)
+                log.warning("skipping corrupt/torn checkpoint %s "
+                            "(checksum mismatch)", path)
+        legacy = os.path.join(ckpt_dir, _LEGACY)
+        if os.path.exists(legacy):
+            return legacy  # pre-rotation layout: no sidecar to validate
+        return None
 
 
 def prune(ckpt_dir: str, keep: int) -> None:

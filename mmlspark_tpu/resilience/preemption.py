@@ -24,6 +24,7 @@ from typing import Optional
 
 from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.observe.trace import trace_event
 
 
 class Preempted(Exception):
@@ -58,6 +59,7 @@ class PreemptionGuard:
     def _handler(self, signum, frame) -> None:
         self.triggered = True
         inc_counter("preempt.sigterm")
+        trace_event("preempt.sigterm", cat="resilience")
         get_logger("resilience").warning(
             "SIGTERM received: finishing the in-flight step, then writing "
             "an emergency checkpoint")

@@ -35,6 +35,7 @@ from typing import Any, Callable, Optional
 from mmlspark_tpu import config
 from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.observe.trace import trace_event
 from mmlspark_tpu.resilience.clock import Clock, get_clock
 
 RETRY_MAX_ATTEMPTS = config.register(
@@ -175,9 +176,18 @@ class RetryPolicy:
                 elapsed = clock.monotonic() - start
                 if not self.classify(exc):
                     inc_counter(f"{self.name}.non_retryable")
+                    trace_event(f"{self.name}.attempt", cat="resilience",
+                                attempt=attempt,
+                                error=type(exc).__name__,
+                                outcome="non_retryable")
                     raise
                 if attempt >= self.max_attempts:
                     inc_counter(f"{self.name}.giveup")
+                    trace_event(f"{self.name}.attempt", cat="resilience",
+                                attempt=attempt,
+                                error=type(exc).__name__,
+                                outcome="giveup",
+                                elapsed_s=round(elapsed, 3))
                     raise RetryBudgetExceeded(
                         f"{self.name}: gave up after {attempt} attempts "
                         f"({elapsed:.1f}s): {exc!r}", attempt,
@@ -188,12 +198,21 @@ class RetryPolicy:
                     delay = hinted
                 if elapsed + delay > self.total_deadline_s:
                     inc_counter(f"{self.name}.giveup")
+                    trace_event(f"{self.name}.attempt", cat="resilience",
+                                attempt=attempt,
+                                error=type(exc).__name__,
+                                outcome="deadline_exceeded",
+                                elapsed_s=round(elapsed, 3))
                     raise RetryBudgetExceeded(
                         f"{self.name}: total deadline "
                         f"{self.total_deadline_s:.1f}s exceeded after "
                         f"{attempt} attempts: {exc!r}", attempt,
                         elapsed) from exc
                 inc_counter(f"{self.name}.retries")
+                trace_event(f"{self.name}.attempt", cat="resilience",
+                            attempt=attempt, error=type(exc).__name__,
+                            outcome="retry_scheduled",
+                            delay_s=round(delay, 3))
                 if on_retry is not None:
                     on_retry(attempt, exc, delay)
                 get_logger("resilience").debug(
@@ -205,6 +224,8 @@ class RetryPolicy:
                     breaker.record_success()
                 if attempt > 1:
                     inc_counter(f"{self.name}.recovered")
+                    trace_event(f"{self.name}.attempt", cat="resilience",
+                                attempt=attempt, outcome="recovered")
                 return result
 
 

@@ -38,6 +38,9 @@ from mmlspark_tpu.models.bundle import ModelBundle, _to_plain
 from mmlspark_tpu.models.definitions import build_model
 from mmlspark_tpu.observe import MetricData, get_logger
 from mmlspark_tpu.observe.spans import active_timings, span_on
+from mmlspark_tpu.observe.trace import (active_tracer, current_span_id,
+                                        span_on_tracer, trace_event,
+                                        trace_span)
 from mmlspark_tpu.parallel.bridge import (gather_replicated, gather_to_host,
                                           put_like, put_sharded, put_tree)
 from mmlspark_tpu.parallel.distributed import (barrier, initialize_distributed,
@@ -308,7 +311,7 @@ class Trainer:
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt,
                                    batch_stats=state.batch_stats)
-            return new_state, loss, {}
+            return new_state, loss, {"grad_norm": optax.global_norm(grads)}
 
         del aux_w  # dense pipeline blocks sow no losses (validated in init)
         return jax.jit(train_step, donate_argnums=(0,))
@@ -348,6 +351,11 @@ class Trainer:
 
             (loss, (new_stats, metrics)), grads = jax.value_and_grad(
                 compute, has_aux=True)(state.params)
+            # the global gradient norm joins the per-step diagnostics (one
+            # tree reduction under jit — noise next to the backward pass);
+            # history gains a grad_norm column and telemetry step spans
+            # carry it as an attr
+            metrics = {**metrics, "grad_norm": optax.global_norm(grads)}
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = TrainState(step=state.step + 1, params=new_params,
@@ -450,6 +458,9 @@ class Trainer:
             if found:
                 state = self.restore_checkpoint(state, ckpt_dir)
                 skip_until = int(state.step)
+                trace_event("train.resume", cat="resilience",
+                            step=skip_until, ckpt_dir=ckpt_dir,
+                            skipped_steps=skip_until - base_step)
                 get_logger("train").info(
                     "resuming from checkpoint at step %d "
                     "(skipping %d completed steps)", skip_until,
@@ -478,6 +489,17 @@ class Trainer:
         # thread as the prefetcher tops up).
         depth = max(0, int(getattr(cfg, "prefetch_depth", 2)))
         timings = active_timings()  # captured: workers have no context
+        # telemetry (observe/trace.py): the tracer handle and the fit-level
+        # span id are captured HERE on the consumer thread and passed into
+        # the staging closure by value — the same capture-by-closure rule
+        # as `timings` above, since worker threads never inherit contextvars
+        tracer = active_tracer()
+        fit_span = tracer.span(
+            "train.fit", parent=current_span_id(), cat="phase",
+            architecture=cfg.architecture, total_steps=total_steps,
+            batch_size=bs, resume_from=skip_until - base_step or 0,
+        ) if tracer is not None else None
+        fit_id = fit_span.span_id if fit_span is not None else None
 
         def plan():
             step_c = base_step
@@ -494,21 +516,23 @@ class Trainer:
 
         def stage(item):
             epoch, step_c, order, start = item
-            with span_on(timings, "host"):
-                idx = order[start:start + bs_local]
-                valid = len(idx)
-                if valid < bs_local:
-                    # cycle real rows into the pad (see module docstring)
-                    idx = np.concatenate([idx,
-                                          np.resize(order,
-                                                    bs_local - valid)])
-                mask = np.zeros(bs_local, np.float32)
-                mask[:valid] = 1.0
-                xh, yh = x[idx], y[idx]
-            with span_on(timings, "transfer"):
-                xb = put_sharded(xh, x_sh)
-                yb = put_sharded(yh, x_sh)
-                mask_d = put_sharded(mask, x_sh)
+            with span_on_tracer(tracer, "train.stage", parent=fit_id,
+                                cat="stage", step=step_c):
+                with span_on(timings, "host"):
+                    idx = order[start:start + bs_local]
+                    valid = len(idx)
+                    if valid < bs_local:
+                        # cycle real rows into the pad (module docstring)
+                        idx = np.concatenate([idx,
+                                              np.resize(order,
+                                                        bs_local - valid)])
+                    mask = np.zeros(bs_local, np.float32)
+                    mask[:valid] = 1.0
+                    xh, yh = x[idx], y[idx]
+                with span_on(timings, "transfer"):
+                    xb = put_sharded(xh, x_sh)
+                    yb = put_sharded(yh, x_sh)
+                    mask_d = put_sharded(mask, x_sh)
             return epoch, step_c, xb, yb, mask_d
 
         losses: list = []
@@ -540,6 +564,7 @@ class Trainer:
                      f"({rec['wall_s']:.1f}s)")
 
         staged = Prefetcher(stage, plan(), depth=depth, name="train")
+        first_exec = True  # the first executed step pays the jit compile
         with PreemptionGuard(install=bool(ckpt_dir)) as guard:
             try:
                 for epoch, step_c, xb, yb, mask_d in staged:
@@ -548,8 +573,31 @@ class Trainer:
                         cur_epoch = epoch
                         losses, step_metrics = [], []
                     chaos.on_step(step_c)  # may deliver simulated SIGTERM
-                    with span_on(timings, "compute"):
-                        state, loss, metrics = step_fn(state, xb, yb, mask_d)
+                    if tracer is None:
+                        with span_on(timings, "compute"):
+                            state, loss, metrics = step_fn(state, xb, yb,
+                                                           mask_d)
+                    else:
+                        # per-step span: the scalar fetches force the step
+                        # to FINISH inside the span, so its wall is the
+                        # true step wall (the sync is the known, pinned
+                        # cost of running with telemetry on)
+                        with tracer.span(
+                                "train.step", parent=fit_id, cat="step",
+                                step=step_c, epoch=epoch,
+                                first_step_compile=first_exec) as sp, \
+                                span_on(timings, "compute"):
+                            state, loss, metrics = step_fn(state, xb, yb,
+                                                           mask_d)
+                            sp.attrs["loss"] = float(jax.device_get(loss))
+                            if "grad_norm" in metrics:
+                                sp.attrs["grad_norm"] = float(
+                                    jax.device_get(metrics["grad_norm"]))
+                            dur = sp.elapsed()
+                            if dur > 0:
+                                sp.attrs["rows_per_sec"] = round(
+                                    bs_local / dur, 1)
+                    first_exec = False
                     losses.append(loss)  # device array; fetched at epoch end
                     if metrics:
                         step_metrics.append(metrics)
@@ -573,10 +621,14 @@ class Trainer:
                     if preempt_now:
                         self.save_checkpoint(state, ckpt_dir)
                         self._last_state = state
+                        trace_event("train.preempted", cat="resilience",
+                                    step=step, ckpt_dir=ckpt_dir)
                         raise Preempted(step=step, ckpt_dir=ckpt_dir)
                 finish_epoch()
             finally:
                 staged.close()
+                if fit_span is not None:
+                    fit_span.finish()
         if ckpt_dir:
             self.save_checkpoint(state, ckpt_dir)
         # the run's loss curve through the typed contract (Metrics.scala:37-47)
@@ -620,19 +672,20 @@ class Trainer:
         multi-host (the gather runs on every process, bounded by the
         collective timeout) but only the coordinator writes, so concurrent
         hosts sharing a filesystem never race."""
-        dev = run_collective(
-            "checkpoint.gather", lambda: gather_replicated(
-                {"step": state.step, "params": state.params,
-                 "opt_state": state.opt_state,
-                 "batch_stats": state.batch_stats},
-                self.mesh))
-        step = int(state.step)
-        if not is_coordinator():
-            # the gather ran (collective); skip the D2H copy and the write
-            return os.path.join(ckpt_dir, checkpoint_name(step))
-        host = jax.device_get(dev)
-        return write_checkpoint(ckpt_dir, step,
-                                serialization.to_bytes(host))
+        with trace_span("checkpoint.save", cat="checkpoint"):
+            dev = run_collective(
+                "checkpoint.gather", lambda: gather_replicated(
+                    {"step": state.step, "params": state.params,
+                     "opt_state": state.opt_state,
+                     "batch_stats": state.batch_stats},
+                    self.mesh))
+            step = int(state.step)
+            if not is_coordinator():
+                # the gather ran (collective); skip the D2H copy + write
+                return os.path.join(ckpt_dir, checkpoint_name(step))
+            host = jax.device_get(dev)
+            return write_checkpoint(ckpt_dir, step,
+                                    serialization.to_bytes(host))
 
     def restore_checkpoint(self, state: TrainState, ckpt_dir: str) -> TrainState:
         """Restore from the newest VALID checkpoint in the coordinator's
@@ -642,6 +695,12 @@ class Trainer:
         shared filesystem required); values reach the other hosts via a
         broadcast collective, with a named barrier + bounded waits so a
         dead peer raises a diagnostic instead of hanging the job."""
+        with trace_span("checkpoint.restore", cat="checkpoint",
+                        ckpt_dir=ckpt_dir):
+            return self._restore_checkpoint(state, ckpt_dir)
+
+    def _restore_checkpoint(self, state: TrainState,
+                            ckpt_dir: str) -> TrainState:
         # from_bytes needs only shapes/dtypes/structure — build the template
         # locally (no collectives, no D2H of live state)
         template = jax.tree_util.tree_map(

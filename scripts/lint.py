@@ -17,6 +17,11 @@ Checks, per file:
     `parallel/prefetch.py` staging pipeline, so every transfer is sharded
     deliberately and visible to the stage-timing spans; a bare device_put
     silently commits to one device and de-pipelines the loop
+  * raw `print(` calls and root-logger `logging.<level>(...)` calls inside
+    `mmlspark_tpu/` — framework output must route through the namespaced
+    logger factory (`observe.logging.get_logger`), so the whole package
+    stays silenceable/redirectable from one knob; `observe/report.py` is
+    whitelisted (it IS the CLI whose product is stdout text)
   * implicit float64 promotion in hot-loop modules — `np.float64`/
     `np.double` references, and `asarray`/`array` calls whose argument is
     a bare python list/tuple literal (or comprehension) with no dtype:
@@ -57,6 +62,16 @@ HOT_LOOP_FILES = {
 HOT_LOOP_DIRS = {
     os.path.join("mmlspark_tpu", "quant"),
 }
+
+# the framework package: raw print()/root-logger output is forbidden here
+# (route through observe.logging); the report CLI is the one whitelisted
+# producer of stdout text
+PACKAGE_DIR = "mmlspark_tpu"
+PRINT_WHITELIST = {
+    os.path.join("mmlspark_tpu", "observe", "report.py"),
+}
+ROOT_LOGGER_METHODS = ("debug", "info", "warning", "error", "critical",
+                       "exception", "log", "basicConfig")
 
 
 def _in_hot_loop(path: str) -> bool:
@@ -100,6 +115,26 @@ def _is_f64_literal_asarray(node: ast.Call) -> bool:
 def _is_f64_reference(node: ast.Attribute) -> bool:
     """Matches `np.float64` / `np.double` style attribute references."""
     return node.attr in ("float64", "double")
+
+
+def _in_package(path: str) -> bool:
+    norm = os.path.normpath(path)
+    return (norm.startswith(PACKAGE_DIR + os.sep)
+            and norm not in PRINT_WHITELIST)
+
+
+def _is_print_call(node: ast.Call) -> bool:
+    return isinstance(node.func, ast.Name) and node.func.id == "print"
+
+
+def _is_root_logger_call(node: ast.Call) -> bool:
+    """Matches `logging.info(...)` etc — emitting through the stdlib ROOT
+    logger instead of the namespaced factory (observe/logging.py)."""
+    fn = node.func
+    return (isinstance(fn, ast.Attribute)
+            and fn.attr in ROOT_LOGGER_METHODS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "logging")
 
 
 def _is_urlopen_call(node: ast.Call) -> bool:
@@ -163,7 +198,21 @@ def check_file(path: str) -> list[str]:
 
     in_resilience = _in_resilience(path)
     in_hot_loop = _in_hot_loop(path)
+    in_package = _in_package(path)
     for node in ast.walk(tree):
+        if in_package and isinstance(node, ast.Call):
+            if _is_print_call(node):
+                problems.append(
+                    f"{path}:{node.lineno}: raw print() inside "
+                    f"mmlspark_tpu/ — route through observe.logging."
+                    f"get_logger (observe/report.py is the whitelisted "
+                    f"CLI)")
+            if _is_root_logger_call(node):
+                problems.append(
+                    f"{path}:{node.lineno}: root-logger logging.* call "
+                    f"inside mmlspark_tpu/ — use observe.logging."
+                    f"get_logger so output stays namespaced under "
+                    f"'mmlspark_tpu'")
         if isinstance(node, ast.ExceptHandler) and node.type is None \
                 and not in_resilience:
             problems.append(f"{path}:{node.lineno}: bare except:")

@@ -67,6 +67,19 @@ def pytest_terminal_summary(terminalreporter):
             terminalreporter.write_line(f"  ALERT {duration:7.1f}s  {nodeid}")
 
 
+@pytest.fixture(autouse=True)
+def _fresh_process_counters():
+    """Process counters are global tallies (observe/metrics.py); without a
+    per-test reset, a counter assertion's truth depends on which tests ran
+    before it (the retry/breaker/checkpoint tests all bump the same
+    namespace).  Zeroing at test START makes every assertion
+    order-independent; run_telemetry additionally reports per-run DELTAS
+    for the same reason."""
+    from mmlspark_tpu.observe.metrics import reset_counters
+    reset_counters()
+    yield
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

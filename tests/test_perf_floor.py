@@ -102,6 +102,16 @@ def test_bench_contract_fields():
     assert result["int8_device_images_per_sec"] > 0
     assert abs(result["int8_accuracy_delta"]) <= 0.005, result
     assert result["int8_agreement"] >= 0.98, result
+    # the telemetry-overhead arm (docs/observability.md): a fully
+    # instrumented scoring pass (run_telemetry recording spans, gauges,
+    # and a run.jsonl) must cost <= 3% over the bare pass — min-of-reps
+    # on both arms, alternated in the same invocation so machine drift
+    # hits both alike.  This is what keeps telemetry affordable always-on.
+    assert {"telemetry_off_images_per_sec", "telemetry_on_images_per_sec",
+            "telemetry_overhead"} <= set(result)
+    assert result["telemetry_off_images_per_sec"] > 0
+    assert result["telemetry_on_images_per_sec"] > 0
+    assert result["telemetry_overhead"] <= 0.03, result
 
 
 def test_bench_decode_contract_fields():

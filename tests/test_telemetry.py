@@ -1,0 +1,488 @@
+"""Unified telemetry subsystem (observe/trace.py + telemetry.py +
+export.py + report.py): structured spans with worker-thread propagation,
+the run_telemetry run record, Perfetto/Prometheus export, and the
+run-report diagnostic.  Everything event-driven — no sleeps."""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observe.telemetry import active_run, run_telemetry
+from mmlspark_tpu.observe.trace import (Tracer, active_tracer,
+                                        current_span_id, trace_event,
+                                        trace_span, tracing)
+
+
+# -- trace.py: spans, parenting, export ------------------------------------
+
+def test_span_nesting_parents_on_one_thread():
+    tracer = Tracer()
+    with tracing(tracer):
+        with trace_span("outer", cat="phase") as outer:
+            assert current_span_id() == outer.span_id
+            with trace_span("inner", cat="step", k=1) as inner:
+                assert inner.attrs == {"k": 1}
+        trace_event("after", cat="marker")
+    recs = {r["name"]: r for r in tracer.records()}
+    assert recs["inner"]["parent"] == recs["outer"]["id"]
+    assert recs["outer"]["parent"] is None
+    assert recs["after"]["parent"] is None          # outer closed first
+    # children close before parents, and both carry real durations
+    assert recs["inner"]["ts"] >= recs["outer"]["ts"]
+    assert recs["outer"]["dur"] >= recs["inner"]["dur"] >= 0
+
+
+def test_span_parenting_across_prefetch_worker_threads():
+    """The capture-by-closure rule: workers never see the consumer's
+    contextvars, so the tracer and parent handle travel into the stage
+    closure by value and worker spans still parent correctly."""
+    import threading
+
+    from mmlspark_tpu.parallel.prefetch import Prefetcher
+
+    tracer = Tracer()
+    consumer_ident = threading.get_ident()
+    worker_idents = []
+    with tracing(tracer):
+        with trace_span("consume", cat="phase") as phase:
+            handle = tracer      # captured ONCE on the consumer thread
+            parent = phase.span_id
+
+            def stage(i):
+                assert active_tracer() is None  # workers have no context
+                worker_idents.append(threading.get_ident())
+                with handle.span("stage", parent=parent, cat="stage",
+                                 item=i):
+                    return i * i
+
+            with Prefetcher(stage, range(6), depth=3) as staged:
+                assert list(staged) == [i * i for i in range(6)]
+    spans = [r for r in tracer.records() if r["name"] == "stage"]
+    assert len(spans) == 6
+    assert sorted(s["attrs"]["item"] for s in spans) == list(range(6))
+    assert all(s["parent"] == parent for s in spans)
+    assert all(ident != consumer_ident for ident in worker_idents)
+    # worker spans carry their own (stable, small-int) thread ids
+    consumer_tid = next(r["thread"] for r in tracer.records()
+                        if r["name"] == "consume")
+    assert all(s["thread"] != consumer_tid for s in spans)
+
+
+def test_trace_ring_is_bounded():
+    tracer = Tracer(ring=8)
+    for i in range(20):
+        tracer.event(f"e{i}")
+    recs = tracer.records()
+    assert len(recs) == 8
+    assert tracer.dropped == 12
+    assert recs[-1]["name"] == "e19"  # newest kept
+
+
+def test_chrome_trace_is_valid_trace_event_json(tmp_path):
+    tracer = Tracer()
+    with tracing(tracer):
+        with trace_span("work", cat="step", step=3):
+            trace_event("mark", cat="compile")
+    path = tracer.write_chrome_trace(str(tmp_path / "trace.json"))
+    doc = json.loads(open(path).read())        # loads == Perfetto-parseable
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    for ev in events:
+        assert {"name", "ph", "ts", "pid"} <= set(ev)
+    complete = [e for e in events if e["ph"] == "X"]
+    instant = [e for e in events if e["ph"] == "i"]
+    assert complete and instant
+    assert complete[0]["dur"] >= 0
+    assert complete[0]["args"]["step"] == 3
+    # instants nested in the span carry its id as parent
+    assert instant[0]["args"]["parent"] == complete[0]["args"]["id"]
+
+
+def test_zero_overhead_fast_path_when_inactive():
+    """No tracer, no run: the ambient helpers return immediately and
+    record nothing, and the hot-loop capture points all see None."""
+    assert active_tracer() is None
+    assert active_run() is None
+    assert trace_event("nope") is None
+    with trace_span("nope") as sp:
+        assert sp is None
+    assert current_span_id() is None
+    # a real hot path with no telemetry active stays span-free end to end
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.models import ConvNetCIFAR10, ModelBundle, TPUModel
+    bundle = ModelBundle.init(ConvNetCIFAR10(), (1, 32, 32, 3), seed=0)
+    model = TPUModel(bundle, inputCol="image", outputCol="scores",
+                     miniBatchSize=8)
+    out = model.transform(
+        DataTable({"image": np.zeros((12, 32, 32, 3), np.uint8)}))
+    assert out["scores"].shape == (12, 10)
+
+
+# -- telemetry.py: the run record ------------------------------------------
+
+def test_run_jsonl_schema_roundtrip(tmp_path):
+    from mmlspark_tpu.observe.metrics import inc_counter
+    d = str(tmp_path / "run")
+    inc_counter("pre.existing", 5)      # must NOT appear in the deltas
+    with run_telemetry(d) as rt:
+        with trace_span("step", cat="step", step=1):
+            pass
+        inc_counter("my.counter", 2)
+        rt.gauge("queue.depth", 3, stage="test")
+        rt.gauge("queue.depth", 1)
+    events = [json.loads(line) for line in open(os.path.join(d, "run.jsonl"))]
+    by_type: dict = {}
+    for ev in events:
+        by_type.setdefault(ev["type"], []).append(ev)
+    assert by_type["run_start"][0]["wall_time"] > 0
+    (span,) = by_type["span"]
+    assert {"name", "id", "parent", "cat", "ts", "dur", "thread",
+            "attrs"} <= set(span)
+    gauges = by_type["gauge"]
+    assert [g["value"] for g in gauges] == [3.0, 1.0]
+    assert gauges[0]["attrs"] == {"stage": "test"}
+    assert by_type["counters"][0]["deltas"] == {"my.counter": 2.0}
+    assert by_type["run_end"][0]["wall_s"] > 0
+    assert "stage_timings" in by_type
+    # the sealed summary agrees with the stream
+    summary = json.load(open(os.path.join(d, "run_summary.json")))
+    assert summary["counters"] == {"my.counter": 2.0}
+    assert summary["gauges"]["queue.depth"] == {"last": 1.0, "max": 3.0,
+                                                "n": 2}
+    assert summary["spans"]["step"]["count"] == 1
+    assert summary == rt.summary()      # finish() sealed it
+
+
+def test_run_telemetry_no_dir_is_memory_only():
+    with run_telemetry() as rt:
+        with trace_span("x", cat="step"):
+            pass
+    assert rt.dir is None
+    assert rt.summary()["spans"]["x"]["count"] == 1
+
+
+def test_run_telemetry_kill_switch():
+    from mmlspark_tpu import config
+    config.set("MMLSPARK_TPU_TELEMETRY", "0")
+    try:
+        with run_telemetry() as rt:
+            assert active_run() is None         # hot loops stay fast-path
+            assert active_tracer() is None
+            rt.gauge("ignored", 1)              # inert, not an error
+        assert rt.summary() == {}
+    finally:
+        config.set("MMLSPARK_TPU_TELEMETRY", None)
+
+
+def test_run_telemetry_dir_from_config(tmp_path):
+    from mmlspark_tpu import config
+    d = str(tmp_path / "from_env")
+    config.set("MMLSPARK_TPU_TELEMETRY_DIR", d)
+    try:
+        with run_telemetry():
+            trace_event("hello")
+    finally:
+        config.set("MMLSPARK_TPU_TELEMETRY_DIR", None)
+    assert os.path.exists(os.path.join(d, "run.jsonl"))
+    assert os.path.exists(os.path.join(d, "run_summary.json"))
+
+
+# -- export.py: Prometheus exposition --------------------------------------
+
+def test_prometheus_exposition_format():
+    import re
+
+    from mmlspark_tpu.observe.export import prometheus_text
+    from mmlspark_tpu.observe.metrics import inc_counter
+    inc_counter("retry.attempts", 3)
+    with run_telemetry() as rt:
+        rt.gauge("prefetch.train.depth", 2)
+        with trace_span("train.step", cat="step"):
+            pass
+        rt.timings.record("host", 0.5)
+        text = prometheus_text()
+    assert "# TYPE mmlspark_tpu_retry_attempts_total counter" in text
+    assert "mmlspark_tpu_retry_attempts_total 3" in text
+    assert "# TYPE mmlspark_tpu_prefetch_train_depth gauge" in text
+    assert "mmlspark_tpu_prefetch_train_depth 2" in text
+    assert 'mmlspark_tpu_span_seconds_total{name="train.step"}' in text
+    assert 'mmlspark_tpu_span_total{name="train.step"} 1' in text
+    assert 'mmlspark_tpu_stage_seconds_total{stage="host"} 0.5' in text
+    # every sample line is exposition-grammar valid
+    sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+$')
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or sample.match(line), line
+
+
+def test_serve_metrics_http_pull():
+    import http.client
+
+    from mmlspark_tpu.observe.export import serve_metrics
+    from mmlspark_tpu.observe.metrics import inc_counter
+    inc_counter("served.counter", 7)
+    server = serve_metrics(port=0)
+    try:
+        port = server.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert "mmlspark_tpu_served_counter_total 7" in body
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# -- report.py: the run diagnostic ------------------------------------------
+
+def _synthetic_run(path: str) -> str:
+    """A hand-built run.jsonl: transfer-bound stages, three steps, one
+    recompile, one retry, one preemption — every report section lit."""
+    events = [
+        {"type": "run_start", "ts": 0.0, "wall_time": 1.0, "pid": 1},
+        {"type": "span", "name": "train.step", "id": 1, "parent": None,
+         "cat": "step", "ts": 0.1, "dur": 0.30, "thread": 0,
+         "attrs": {"step": 0, "loss": 2.0, "first_step_compile": True}},
+        {"type": "event", "name": "recompile", "id": 2, "parent": None,
+         "cat": "compile", "ts": 0.1, "thread": 0,
+         "attrs": {"where": "tpu_model", "shape_class": "(8, 4):float32"}},
+        {"type": "span", "name": "train.step", "id": 3, "parent": None,
+         "cat": "step", "ts": 0.5, "dur": 0.01, "thread": 0,
+         "attrs": {"step": 1, "loss": 1.0}},
+        {"type": "event", "name": "fetch.attempt", "id": 4, "parent": None,
+         "cat": "resilience", "ts": 0.6, "thread": 0,
+         "attrs": {"attempt": 1, "outcome": "retry_scheduled"}},
+        {"type": "span", "name": "train.step", "id": 5, "parent": None,
+         "cat": "step", "ts": 0.7, "dur": 0.05, "thread": 0,
+         "attrs": {"step": 2, "loss": 0.5}},
+        {"type": "event", "name": "train.preempted", "id": 6,
+         "parent": None, "cat": "resilience", "ts": 0.8, "thread": 0,
+         "attrs": {"step": 3}},
+        {"type": "counters", "ts": 0.9, "deltas": {"retry.retries": 1.0}},
+        {"type": "stage_timings", "ts": 0.9,
+         "seconds": {"host": 0.1, "transfer": 0.8, "compute": 0.3,
+                     "drain": 0.05},
+         "summary": {}},
+        {"type": "run_end", "ts": 0.9, "wall_s": 0.9},
+    ]
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+        f.write('{"torn tail')    # a killed run stops mid-line
+    return path
+
+
+def test_report_verdict_on_synthetic_run(tmp_path):
+    from mmlspark_tpu.observe.report import (build_report, load_run,
+                                             render_report)
+    path = _synthetic_run(str(tmp_path / "run.jsonl"))
+    events = load_run(path)               # torn tail skipped, not raised
+    report = build_report(events, top=2)
+    # the bottleneck verdict reuses spans.py's logic: transfer dominates
+    assert report["bottleneck"] == "transfer"
+    assert report["stage_seconds"]["transfer"] == 0.8
+    # slowest steps ranked by duration, truncated to top
+    assert [s["attrs"]["step"] for s in report["slowest_steps"]] == [0, 2]
+    assert [e["attrs"]["shape_class"] for e in report["recompiles"]] \
+        == ["(8, 4):float32"]
+    # resilience timeline in ts order: retry then preemption
+    assert [e["name"] for e in report["resilience"]] \
+        == ["fetch.attempt", "train.preempted"]
+    assert report["counters"] == {"retry.retries": 1.0}
+    text = render_report(report)
+    assert "bottleneck verdict: transfer" in text
+    assert "train.preempted" in text and "recompile" in text
+    assert "retry.retries" in text
+
+
+def test_report_cli_prints_verdict(tmp_path, capsys):
+    from mmlspark_tpu.observe import report
+    _synthetic_run(str(tmp_path / "run.jsonl"))
+    assert report.main([str(tmp_path)]) == 0    # a run DIR also resolves
+    out = capsys.readouterr().out
+    assert "mmlspark_tpu run report" in out
+    assert "bottleneck verdict: transfer" in out
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert report.main([str(empty)]) == 1      # no events: nonzero exit
+    capsys.readouterr()
+
+
+# -- instrumented hot paths under one run -----------------------------------
+
+def test_end_to_end_train_score_decode_run(tmp_path):
+    """The acceptance flow: ONE run_telemetry block around
+    Trainer.fit_arrays + TPUModel.transform + TextGenerator.transform
+    produces per-step/per-batch/per-segment spans, counter deltas,
+    recompile gauges, a loadable Perfetto export, and a report verdict."""
+    import jax
+
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.models import (ConvNetCIFAR10, ModelBundle, TPUModel,
+                                     TextGenerator)
+    from mmlspark_tpu.models.definitions import build_model
+    from mmlspark_tpu.observe.report import build_report, load_run
+    from mmlspark_tpu.train import TrainerConfig
+    from mmlspark_tpu.train.trainer import Trainer
+
+    d = str(tmp_path / "run")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((48, 4)).astype(np.float32)
+    y = (x @ np.asarray([1., -2., 0.5, 0.], np.float32)).astype(np.float32)
+    lm = build_model("TransformerLM", {
+        "vocab_size": 64, "d_model": 32, "n_heads": 2, "n_layers": 1,
+        "max_len": 64})
+    lm_vars = lm.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    prompts = np.empty(2, object)
+    prompts[0] = rng.integers(0, 64, (5,)).astype(np.int32)
+    prompts[1] = rng.integers(0, 64, (9,)).astype(np.int32)
+
+    with run_telemetry(d) as rt:
+        cfg = TrainerConfig(architecture="LinearModel",
+                            model_config={"num_outputs": 1},
+                            optimizer="sgd", learning_rate=0.1, epochs=2,
+                            batch_size=16, loss="mse", seed=0,
+                            checkpoint_dir=str(tmp_path / "ckpt"))
+        Trainer(cfg).fit_arrays(x, y)
+        bundle = ModelBundle.init(ConvNetCIFAR10(), (1, 32, 32, 3), seed=0)
+        TPUModel(bundle, inputCol="image", outputCol="s",
+                 miniBatchSize=16).transform(
+            DataTable({"image": np.zeros((24, 32, 32, 3), np.uint8)}))
+        TextGenerator(ModelBundle.from_module(lm, lm_vars),
+                      inputCol="prompt", outputCol="out",
+                      maxNewTokens=4).transform(
+            DataTable({"prompt": prompts}))
+        trace_path = rt.write_chrome_trace()
+
+    events = load_run(d)
+    spans = {e["name"] for e in events if e["type"] == "span"}
+    assert {"train.fit", "train.step", "train.stage",
+            "score.transform_batches", "score.batch", "score.stage",
+            "decode.generate", "decode.prefill", "decode.segment",
+            "checkpoint.write", "checkpoint.save"} <= spans
+    steps = [e for e in events
+             if e["type"] == "span" and e["name"] == "train.step"]
+    assert len(steps) == 6          # 3 steps/epoch x 2 epochs
+    assert steps[0]["attrs"]["first_step_compile"] is True
+    assert not any(s["attrs"]["first_step_compile"] for s in steps[1:])
+    for s in steps:
+        assert {"step", "epoch", "loss", "grad_norm",
+                "rows_per_sec"} <= set(s["attrs"])
+    # step spans nest under the fit phase; stage spans ran on workers
+    fit = next(e for e in events
+               if e["type"] == "span" and e["name"] == "train.fit")
+    assert all(s["parent"] == fit["id"] for s in steps)
+    # recompile detectors: shape-class events + compiled-program gauges
+    compiles = [e for e in events
+                if e["type"] == "event" and e["cat"] == "compile"]
+    assert {c["attrs"]["where"] for c in compiles} \
+        >= {"tpu_model", "decode"}
+    summary = json.load(open(os.path.join(d, "run_summary.json")))
+    assert summary["counters"].get("checkpoint.writes", 0) >= 1
+    assert "tpu_model.shape_classes" in summary["gauges"]
+    assert "decode.compiled_programs" in summary["gauges"]
+    assert "prefetch.train.depth" in summary["gauges"]
+    assert summary["stage_timings"]["bottleneck"] is not None
+    # segment spans carry the occupancy attr the decode engine claims
+    seg = next(e for e in events
+               if e["type"] == "span" and e["name"] == "decode.segment")
+    assert 0 < seg["attrs"]["occupancy"] <= 1
+    # the Perfetto export of the SAME run loads as trace-event JSON
+    doc = json.loads(open(trace_path).read())
+    assert any(e["ph"] == "X" and e["name"] == "train.step"
+               for e in doc["traceEvents"])
+    # and the report replays it to a verdict
+    report = build_report(events)
+    assert report["bottleneck"] is not None
+    assert report["slowest_steps"]
+
+
+def test_preempted_run_records_resilience_timeline(tmp_path):
+    """Chaos-preempted training under run_telemetry leaves the preemption
+    in the run record, and the resumed run logs its resume event."""
+    from mmlspark_tpu import config
+    from mmlspark_tpu.observe.report import build_report, load_run
+    from mmlspark_tpu.resilience.chaos import reset_chaos
+    from mmlspark_tpu.resilience.preemption import Preempted
+    from mmlspark_tpu.train import TrainerConfig
+    from mmlspark_tpu.train.trainer import Trainer
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((48, 4)).astype(np.float32)
+    y = (x @ np.asarray([1., -2., 0.5, 0.], np.float32)).astype(np.float32)
+    ckpt = str(tmp_path / "ckpt")
+    cfg = TrainerConfig(architecture="LinearModel",
+                        model_config={"num_outputs": 1}, optimizer="sgd",
+                        learning_rate=0.1, epochs=2, batch_size=16,
+                        loss="mse", seed=0, checkpoint_dir=ckpt)
+    d1, d2 = str(tmp_path / "r1"), str(tmp_path / "r2")
+    config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", 2)
+    reset_chaos()
+    try:
+        with run_telemetry(d1):
+            with pytest.raises(Preempted):
+                Trainer(cfg).fit_arrays(x, y)
+    finally:
+        config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", None)
+        reset_chaos()
+    r1 = build_report(load_run(d1))
+    names = [e["name"] for e in r1["resilience"]]
+    assert "chaos.preemption" in names
+    assert "preempt.sigterm" in names
+    assert "train.preempted" in names
+    with run_telemetry(d2):
+        Trainer(cfg).fit_arrays(x, y, resume=True)
+    r2 = build_report(load_run(d2))
+    assert "train.resume" in [e["name"] for e in r2["resilience"]]
+
+
+# -- satellites --------------------------------------------------------------
+
+def test_profiler_probe_failure_is_logged(tmp_path, monkeypatch, caplog):
+    """A real signature-probe failure must log, not silently downgrade."""
+    import inspect as real_inspect
+
+    from mmlspark_tpu.observe import profiler
+
+    def boom(fn):
+        raise ImportError("probe exploded")
+
+    monkeypatch.setattr(real_inspect, "signature", boom)
+    with caplog.at_level(logging.WARNING, logger="mmlspark_tpu.observe"):
+        with profiler.profile(str(tmp_path / "t")):
+            pass
+    assert any("probe failed" in r.message for r in caplog.records)
+
+
+def test_profiler_annotate_passthrough(monkeypatch):
+    """annotate() degrades to an inert context when TraceAnnotation is
+    unavailable (off-TPU jax builds), so caller code stays unconditional."""
+    import jax
+
+    from mmlspark_tpu.observe.profiler import annotate
+    with annotate("works"):     # the real one works on any backend
+        pass
+
+    class Exploding:
+        def __init__(self, name):
+            raise RuntimeError("no profiler on this build")
+
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", Exploding)
+    with annotate("degraded"):  # no raise: the passthrough path
+        pass
+
+
+def test_counter_reset_fixture_isolates_tests():
+    """The conftest autouse fixture zeroes counters per test, so this
+    assertion holds regardless of which tests ran before."""
+    from mmlspark_tpu.observe.metrics import counters_snapshot, inc_counter
+    assert counters_snapshot() == {}
+    inc_counter("isolated.counter")
+    assert counters_snapshot() == {"isolated.counter": 1.0}

@@ -1,6 +1,6 @@
 # Developer entry points (the reference's `runme` + sbt targets,
 # tools/runme/runme.sh:30-52 + src/project/build.scala).
-.PHONY: check check-full test test-full lint bench bench-smoke tpu-floors install docs notebooks clean
+.PHONY: check check-full test test-full lint bench bench-smoke bench-history tpu-floors install docs notebooks clean
 
 check:            ## full gate: syntax + lint + suite + dryrun + bench smoke
 	bash scripts/check.sh
@@ -20,9 +20,14 @@ lint:             ## AST lint (unused imports, bare except, tabs)
 bench:            ## full benchmark on the available backend
 	python bench.py
 
-bench-smoke:      ## lint + tiny-size bench incl. quantized + telemetry-overhead arms (JSON contract check, no TPU needed)
+bench-smoke:      ## lint + tiny-size bench incl. quantized + telemetry-overhead arms (JSON contract check, no TPU needed) + history regression check vs the committed baseline
 	python scripts/lint.py
-	python bench.py --smoke
+	python bench.py --smoke | tee /tmp/mmlspark_tpu_bench_smoke.json
+	python -m mmlspark_tpu.observe.history check /tmp/mmlspark_tpu_bench_smoke.json --store tests/bench_history_smoke.jsonl
+
+bench-history:    ## append a full bench run to the local history store and print verdicts
+	python bench.py | tee /tmp/mmlspark_tpu_bench.json
+	python -m mmlspark_tpu.observe.history ingest /tmp/mmlspark_tpu_bench.json
 
 tpu-floors:       ## throughput/MFU floors on a real TPU chip
 	MMLSPARK_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_perf_floor.py -q

@@ -41,6 +41,7 @@ class ConfigVar:
 
 _registry: dict[str, ConfigVar] = {}
 _overrides: dict[str, Any] = {}
+_declared_by: dict[str, str] = {}  # var name -> declaring module
 
 
 def register(name: str, default: Any = None, doc: str = "",
@@ -58,6 +59,10 @@ def register(name: str, default: Any = None, doc: str = "",
         return existing  # identical re-declaration: keep the one instance
     var = ConfigVar(name, default, doc, ptype)
     _registry[name] = var
+    # provenance, so generated docs can list the FRAMEWORK's variables
+    # without picking up test/application declarations made in-process
+    import sys
+    _declared_by[name] = sys._getframe(1).f_globals.get("__name__", "")
     return var
 
 
@@ -80,9 +85,12 @@ def set(name: str, value: Any) -> None:  # noqa: A001 - mirrors Configuration.se
 
 
 def describe() -> list[dict]:
-    """Every registered variable with default, doc, and current value."""
+    """Every registered variable with default, doc, current value, and the
+    module that declared it (so generated docs can keep test/application
+    declarations made in-process out of the framework's reference table)."""
     return [{"name": v.name, "default": v.default, "doc": v.doc,
-             "current": v.current()} for v in
+             "current": v.current(),
+             "declared_by": _declared_by.get(v.name, "")} for v in
             sorted(_registry.values(), key=lambda v: v.name)]
 
 

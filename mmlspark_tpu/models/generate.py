@@ -79,6 +79,7 @@ from jax import lax
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.models.bundle import load_bundle, save_bundle
+from mmlspark_tpu.observe.costmodel import capture_program_cost
 from mmlspark_tpu.observe.spans import active_timings, span_on
 from mmlspark_tpu.observe.telemetry import active_run
 from mmlspark_tpu.observe.trace import trace_event, trace_span
@@ -710,6 +711,9 @@ class DecodeEngine:
         self._prefill = jax.jit(prefill_impl)
         self._segment = jax.jit(segment_impl, static_argnums=(0, 1))
         self._programs: set = set()
+        self._program_costs: dict = {}  # program key -> captured cost row
+        # (captured once at the recompile; replayed into every later
+        # run_telemetry block so warm-engine runs still get roofline rows)
         self.last_segments_run = 0
         self.last_new_tokens_computed = 0
 
@@ -764,15 +768,33 @@ class DecodeEngine:
         run = active_run()
         with trace_span("decode.generate", cat="phase", bucket=p, batch=b,
                         max_new_tokens=self.max_new_tokens):
+            pf_key = ("prefill", b, p)
+            pf_args = (variables, jnp.asarray(prompts),
+                       jnp.asarray(true_len), jnp.asarray(live), row_keys)
+            if run is not None and pf_key not in self._programs:
+                # compile-time cost capture (observe/costmodel.py): once
+                # per program, with a synced probe execution — the live
+                # span below walls only the async dispatch
+                rec = capture_program_cost(self._prefill, pf_args,
+                                           where="decode", program=pf_key,
+                                           run=run, probe=True)
+                if rec is not None:
+                    self._program_costs[pf_key] = rec
             with span_on(timings, "prefill"), \
                     trace_span("decode.prefill", cat="bucket", bucket=p,
-                               batch=b):
-                tok, done, caches = self._prefill(
-                    variables, jnp.asarray(prompts), jnp.asarray(true_len),
-                    jnp.asarray(live), row_keys)
+                               batch=b) as psp:
+                tok, done, caches = self._prefill(*pf_args)
                 if timings is not None:
                     jax.block_until_ready(tok)
-            self._program("prefill", b, p)
+            self._program(*pf_key)
+            if run is not None and psp is not None:
+                # replay the remembered cost row so warm-engine runs (no
+                # recompile) still get roofline rows (idempotent)
+                if pf_key in self._program_costs:
+                    run.record_program_cost("decode", pf_key,
+                                            self._program_costs[pf_key])
+                run.add_program_time("decode", pf_key, psp.elapsed(),
+                                     basis="dispatch")
             segs = decode_segments(p, self.max_new_tokens, self.chunk)
             check_exit = bool(self.stop_tokens)
             prev_w = _round_up(p + 1, self.chunk)
@@ -787,18 +809,39 @@ class DecodeEngine:
                                     segments_skipped=len(segs)
                                     - segments_run)
                         break
+                    seg_key = ("segment", b, prev_w, window, seg_len)
+                    seg_args = (seg_len, window, variables, caches, tok,
+                                done, jnp.asarray(true_len),
+                                jnp.asarray(p, jnp.int32),
+                                jnp.asarray(t0, jnp.int32), row_keys)
+                    if run is not None and seg_key not in self._programs:
+                        # captured BEFORE the call: the caches are
+                        # rebound to window-grown outputs after it
+                        rec = capture_program_cost(self._segment, seg_args,
+                                                   where="decode",
+                                                   program=seg_key, run=run,
+                                                   probe=True,
+                                                   static_argnums=(0, 1))
+                        if rec is not None:
+                            self._program_costs[seg_key] = rec
                     # occupancy: cache slots live after this segment over
                     # the slots the compiled step actually attends
                     with trace_span("decode.segment", cat="segment",
                                     window=window, seg_len=seg_len,
                                     step_offset=t0,
                                     occupancy=round(
-                                        (p + t0 + seg_len) / window, 3)):
-                        caches, toks, tok, done = self._segment(
-                            seg_len, window, variables, caches, tok, done,
-                            jnp.asarray(true_len), jnp.asarray(p, jnp.int32),
-                            jnp.asarray(t0, jnp.int32), row_keys)
-                    self._program("segment", b, prev_w, window, seg_len)
+                                        (p + t0 + seg_len) / window, 3)) \
+                            as ssp:
+                        caches, toks, tok, done = self._segment(*seg_args)
+                    self._program(*seg_key)
+                    if run is not None and ssp is not None:
+                        if seg_key in self._program_costs:
+                            run.record_program_cost(
+                                "decode", seg_key,
+                                self._program_costs[seg_key])
+                        run.add_program_time("decode", seg_key,
+                                             ssp.elapsed(),
+                                             basis="dispatch")
                     prev_w = window
                     parts.append(toks)
                     segments_run += 1

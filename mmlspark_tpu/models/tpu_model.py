@@ -28,6 +28,7 @@ from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
+from mmlspark_tpu.observe.costmodel import capture_program_cost
 from mmlspark_tpu.observe.spans import active_timings, span_on
 from mmlspark_tpu.observe.telemetry import active_run
 from mmlspark_tpu.observe.trace import (active_tracer, current_span_id,
@@ -81,6 +82,10 @@ class TPUModel(Transformer):
         self._seen_shapes: set = set()           # batch shape classes scored
         # (jit specializes per shape class: a NEW key here is a recompile,
         # surfaced as a telemetry `compile` event and counted as a gauge)
+        self._program_costs: dict[str, dict] = {}  # shape class -> cost row
+        # (captured once at the recompile; replayed into every later
+        # run_telemetry block, so a warm model's steady-state runs still
+        # get roofline rows without paying a fresh AOT capture)
 
     # -- model/mesh wiring ---------------------------------------------
     def set_bundle(self, bundle: ModelBundle) -> "TPUModel":
@@ -88,6 +93,7 @@ class TPUModel(Transformer):
         self._device_vars.clear()
         self._compiled.clear()
         self._seen_shapes.clear()
+        self._program_costs.clear()
         return self
 
     @property
@@ -99,6 +105,7 @@ class TPUModel(Transformer):
         self._device_vars.clear()
         self._compiled.clear()
         self._seen_shapes.clear()
+        self._program_costs.clear()
         return self
 
     def _get_mesh(self):
@@ -307,12 +314,29 @@ class TPUModel(Transformer):
                     tracer.event("recompile", parent=current_span_id(),
                                  cat="compile", where="tpu_model",
                                  shape_class=key)
+                    rec = capture_program_cost(apply_fn, (variables, dev),
+                                               where="tpu_model",
+                                               program=key, run=run,
+                                               probe=True)
+                    if rec is not None:
+                        self._program_costs[key] = rec
                 with tracer.span("score.batch",
                                  parent=current_span_id(), cat="batch",
                                  shape_class=key, rows=valid,
-                                 device_cached=True), \
+                                 device_cached=True) as bsp, \
                         span_on(timings, "compute"):
                     out = apply_fn(variables, dev)
+                if run is not None:
+                    # dispatch wall only (async) — the roofline uses the
+                    # capture probe's synced step time instead.  The cost
+                    # row is replayed from the model's remembered capture
+                    # so runs over a warm model (no recompile) still get
+                    # roofline rows (record_program_cost is idempotent)
+                    if key in self._program_costs:
+                        run.record_program_cost("tpu_model", key,
+                                                self._program_costs[key])
+                    run.add_program_time("tpu_model", key, bsp.elapsed(),
+                                         basis="dispatch")
             try:
                 out.copy_to_host_async()
             except (AttributeError, RuntimeError):
@@ -458,11 +482,30 @@ class TPUModel(Transformer):
                             tracer.event("recompile", parent=score_id,
                                          cat="compile", where="tpu_model",
                                          shape_class=key)
+                            cost_rec = capture_program_cost(
+                                apply_fn, (variables, dev),
+                                where="tpu_model", program=key, run=run,
+                                probe=True)
+                            if cost_rec is not None:
+                                self._program_costs[key] = cost_rec
                         with tracer.span("score.batch", parent=score_id,
                                          cat="batch", shape_class=key,
-                                         rows=valid), \
+                                         rows=valid) as bsp, \
                                 span_on(timings, "compute"):
                             out = apply_fn(variables, dev)
+                        if run is not None:
+                            # dispatch wall (async); roofline prefers the
+                            # capture probe's synced step time.  The cost
+                            # row is replayed from the model's remembered
+                            # capture so warm-model runs (no recompile)
+                            # still get roofline rows (idempotent)
+                            if key in self._program_costs:
+                                run.record_program_cost(
+                                    "tpu_model", key,
+                                    self._program_costs[key])
+                            run.add_program_time("tpu_model", key,
+                                                 bsp.elapsed(),
+                                                 basis="dispatch")
                     try:
                         out.copy_to_host_async()
                     except (AttributeError, RuntimeError):

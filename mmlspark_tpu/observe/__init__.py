@@ -1,11 +1,18 @@
 """Observability: logger factory, typed metric contract, stage timers,
 device profiling, and the unified telemetry subsystem — structured run
 traces (trace.py), the run_telemetry run record (telemetry.py),
-Prometheus export (export.py), and the run-report diagnostic (report.py).
+Prometheus export (export.py), the run-report diagnostic (report.py),
+and the analytics layer that interprets it all: per-program roofline
+attribution (costmodel.py), numerics health probes (numerics.py), and
+the persistent bench-history regression store (history.py).
 Reference Logging.scala:14-23 + Metrics.scala:37-47 + TestBase.scala:
 138-153; everything past the loggers is TPU-native headroom."""
 
+from mmlspark_tpu.observe.costmodel import (capture_program_cost,
+                                            costmodel_enabled, roofline)
 from mmlspark_tpu.observe.logging import LOG_ROOT, get_logger
+from mmlspark_tpu.observe.numerics import (LossSpikeDetector,
+                                           NonFiniteError, tree_health)
 from mmlspark_tpu.observe.metrics import (MetricData, counters_metric_data,
                                           counters_snapshot, get_counter,
                                           inc_counter, reset_counters)
@@ -30,4 +37,6 @@ __all__ = ["LOG_ROOT", "get_logger", "MetricData", "annotate", "profile",
            "Span", "Tracer", "active_tracer", "current_span_id",
            "trace_event", "trace_span",
            "RunTelemetry", "active_run", "run_telemetry",
-           "prometheus_text", "serve_metrics", "write_metrics"]
+           "prometheus_text", "serve_metrics", "write_metrics",
+           "capture_program_cost", "costmodel_enabled", "roofline",
+           "LossSpikeDetector", "NonFiniteError", "tree_health"]

@@ -42,6 +42,13 @@ def _metric_name(name: str, suffix: str = "") -> str:
     return f"{PREFIX}_{base}{suffix}"
 
 
+def _label_value(value) -> str:
+    """Escape a label value per the exposition grammar (backslash, quote,
+    newline) — program keys carry shape tuples like '(16, 32, 3):uint8'."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def _fmt(value: float) -> str:
     value = float(value)
     return repr(int(value)) if value == int(value) else repr(value)
@@ -85,6 +92,44 @@ def prometheus_text(run: Optional[RunTelemetry] = None) -> str:
             for name in sorted(agg):
                 lines.append(f'{cnt}{{name="{name}"}} '
                              f"{_fmt(agg[name]['count'])}")
+
+        progs = run.program_summary()
+        if progs:
+            # the roofline gauges (observe/costmodel.py): one sample per
+            # compiled program, labeled by call site + shape-class key.
+            # Every metric name gets its # HELP/# TYPE metadata once —
+            # the exposition-grammar test covers these lines too.
+            fields = (
+                ("program_mfu", "mfu",
+                 "model-FLOPs utilization per compiled program "
+                 "(achieved FLOP/s over the chip bf16 peak)"),
+                ("program_hbm_bw_util", "hbm_bw_util",
+                 "HBM-bandwidth utilization per compiled program "
+                 "(achieved bytes/s over the chip HBM peak)"),
+                ("program_step_seconds", "step_s",
+                 "per-execution seconds of one compiled program "
+                 "(span wall or capture probe; see step_basis)"),
+                ("program_flops", "flops",
+                 "FLOPs per execution of one compiled program "
+                 "(XLA cost_analysis at compile time)"),
+                ("program_bytes_accessed", "bytes_accessed",
+                 "bytes accessed per execution of one compiled program "
+                 "(XLA cost_analysis at compile time)"),
+            )
+            for metric_base, field, help_text in fields:
+                samples = [(key, p[field]) for key, p in sorted(
+                    progs.items()) if p.get(field) is not None]
+                if not samples:
+                    continue
+                metric = _metric_name(metric_base)
+                lines.append(f"# HELP {metric} {help_text}")
+                lines.append(f"# TYPE {metric} gauge")
+                for key, value in samples:
+                    p = progs[key]
+                    lines.append(
+                        f'{metric}{{where="{_label_value(p["where"])}",'
+                        f'program="{_label_value(p["program"])}"}} '
+                        f"{_fmt(value)}")
 
         if run.timings.seconds:
             stage = _metric_name("stage_seconds", "_total")

@@ -1,0 +1,178 @@
+"""Numerics health: jitted tree probes + rolling loss-spike detection.
+
+A NaN'd loss wastes everything downstream of it — the steps that keep
+running, the checkpoint rotation that happily promotes the poisoned
+state to LATEST, the bench run whose numbers are garbage.  The framework
+measures everything else about a training run (PR 5); this module makes
+it measure the run's *health*:
+
+  * `tree_health` — a flat dict of scalar diagnostics over the step's
+    trees, built to run INSIDE the jitted train step: non-finite counts
+    (params / grads / the logits activation), global and per-layer-group
+    grad/param norms, and per-group update-to-weight ratios (the
+    learning-rate sanity signal).  Cadence control lives in the step
+    itself (`lax.cond` on a traced `probe` flag — `train/trainer.py`),
+    so off-cadence steps pay one predicate, not the reductions.
+  * `LossSpikeDetector` — a rolling-median/MAD detector over the
+    per-step loss: `nonfinite` immediately, `spike` when a loss jumps
+    past the noise envelope, `divergence` when spikes sustain.  Verdicts
+    emit resilience-style telemetry events, so they land in the same
+    run-report timeline as retries and preemptions.
+  * `NonFiniteError` — raised by the trainer (opt-in
+    `TrainerConfig.halt_on_nonfinite`) when a probe sees non-finite
+    state, BEFORE the step-boundary checkpoint runs: the last finite
+    checkpoint stays LATEST instead of being rotated out by a poisoned
+    one.
+
+Chaos integration: `MMLSPARK_TPU_CHAOS_NAN_AT_STEP` (resilience/chaos.py)
+poisons one step's loss mask with NaN, so detection-within-one-interval
+and checkpoint preservation are testable, deterministically, on any
+backend.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# layer groups: params are grouped by their top-level module name
+# ("Dense_0", "blocks_2", ...) — coarse enough to stay a handful of
+# scalars, fine enough to localize which block's gradients blew up
+
+
+def _group_of(path) -> str:
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is not None:
+            return str(key)
+    return "params"
+
+
+def _grouped_sq_sums(tree) -> dict:
+    """{group: sum of squares} over a tree, one scalar per top-level
+    module (runs under jit: static structure, scalar reductions)."""
+    out: dict = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        g = _group_of(path)
+        sq = jnp.sum(jnp.square(jnp.asarray(leaf, jnp.float32)))
+        out[g] = out.get(g, 0.0) + sq
+    return out
+
+
+def _nonfinite_count(tree) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(~jnp.isfinite(jnp.asarray(leaf, jnp.float32)))
+               for leaf in leaves).astype(jnp.float32)
+
+
+def tree_health(params, grads, updates, acts=None) -> dict:
+    """The flat health dict (all float32 scalars), jit-safe.
+
+    Keys: `nonfinite_params` / `nonfinite_grads` / `nonfinite_acts`
+    (element counts), `grad_norm/<group>`, `param_norm/<group>`,
+    `update_ratio/<group>` (||update|| / (||param|| + eps) — the
+    update-to-weight ratio, the classic learning-rate health signal),
+    plus `act_norm` over `acts` (the step's logits) when given.
+    """
+    eps = 1e-12
+    health: dict = {
+        "nonfinite_params": _nonfinite_count(params),
+        "nonfinite_grads": _nonfinite_count(grads),
+    }
+    p_sq = _grouped_sq_sums(params)
+    g_sq = _grouped_sq_sums(grads)
+    u_sq = _grouped_sq_sums(updates)
+    for g in p_sq:
+        p_norm = jnp.sqrt(p_sq[g])
+        health[f"param_norm/{g}"] = p_norm
+        if g in g_sq:
+            health[f"grad_norm/{g}"] = jnp.sqrt(g_sq[g])
+        if g in u_sq:
+            health[f"update_ratio/{g}"] = jnp.sqrt(u_sq[g]) / (p_norm + eps)
+    if acts is not None:
+        acts = jnp.asarray(acts, jnp.float32)
+        health["act_norm"] = jnp.sqrt(jnp.sum(jnp.square(acts)))
+        health["nonfinite_acts"] = jnp.sum(
+            ~jnp.isfinite(acts)).astype(jnp.float32)
+    return {k: jnp.asarray(v, jnp.float32) for k, v in health.items()}
+
+
+def zeros_like_health(health: dict) -> dict:
+    """The off-cadence lax.cond branch: same structure, zero cost."""
+    return {k: jnp.zeros((), jnp.float32) for k in health}
+
+
+class NonFiniteError(RuntimeError):
+    """Training state went non-finite and halt_on_nonfinite is armed.
+
+    Raised at the step boundary BEFORE any checkpoint write, so the
+    newest checkpoint on disk is the last finite one.
+    """
+
+    def __init__(self, step: int, detail: str,
+                 ckpt_dir: Optional[str] = None):
+        self.step = step
+        self.detail = detail
+        self.ckpt_dir = ckpt_dir
+        msg = (f"non-finite training state detected at step {step} "
+               f"({detail})")
+        if ckpt_dir:
+            msg += (f"; halting before the poisoned state reaches a "
+                    f"checkpoint — the newest valid checkpoint in "
+                    f"{ckpt_dir} is the last finite state")
+        super().__init__(msg)
+
+
+class LossSpikeDetector:
+    """Rolling loss-health verdicts: ok | spike | divergence | nonfinite.
+
+    Noise model: the rolling median and MAD of the last `window` FINITE
+    losses define the envelope; a loss above
+    `median + spike_sigmas * (1.4826 * MAD + eps)` is a `spike` (the
+    MAD floor `min_rel * |median|` keeps an early flat history from
+    flagging ordinary jitter), and `div_consecutive` consecutive spikes
+    are a `divergence`.  Spiking observations do NOT enter the baseline
+    — a diverging run cannot normalize its own spikes away.
+    """
+
+    def __init__(self, window: int = 25, spike_sigmas: float = 6.0,
+                 min_rel: float = 0.1, div_consecutive: int = 3,
+                 warmup: int = 5):
+        self.window = window
+        self.spike_sigmas = spike_sigmas
+        self.min_rel = min_rel
+        self.div_consecutive = div_consecutive
+        self.warmup = warmup
+        self._recent: deque = deque(maxlen=window)
+        self._spike_run = 0
+
+    def threshold(self) -> Optional[float]:
+        """The current spike threshold, or None during warmup."""
+        if len(self._recent) < self.warmup:
+            return None
+        xs = sorted(self._recent)
+        n = len(xs)
+        med = (xs[n // 2] if n % 2 else (xs[n // 2 - 1] + xs[n // 2]) / 2)
+        mad = sorted(abs(x - med) for x in xs)[n // 2]
+        sigma = max(1.4826 * mad, self.min_rel * abs(med), 1e-9)
+        return med + self.spike_sigmas * sigma
+
+    def update(self, loss: float) -> str:
+        """Feed one per-step loss; returns the verdict for this step."""
+        if not math.isfinite(loss):
+            self._spike_run += 1
+            return "nonfinite"
+        thr = self.threshold()
+        if thr is not None and loss > thr:
+            self._spike_run += 1
+            return ("divergence"
+                    if self._spike_run >= self.div_consecutive else "spike")
+        self._spike_run = 0
+        self._recent.append(loss)
+        return "ok"

@@ -1,7 +1,12 @@
 """Run-report diagnostic: replay a run.jsonl into the human answer to
 "what did that run actually do, and what bounded it".
 
-    python -m mmlspark_tpu.observe.report <run_dir_or_run.jsonl> [--top N]
+    python -m mmlspark_tpu.observe.report <run_dir_or_run.jsonl> \
+        [--top N] [--format text|json]
+
+`--format json` prints the structured report itself (one JSON object,
+every section machine-readable) — the CI-consumption mode; the default
+text rendering is for humans.
 
 Sections (each a structured field of `build_report`, rendered by
 `render_report` — so tools can consume the dict while humans read the
@@ -15,6 +20,12 @@ text):
     duration, with their attrs (the "what did step 1234 do" query);
   * **recompiles** — `cat="compile"` events: every new shape class /
     compiled program the run paid for, in order;
+  * **roofline** — the per-program cost table (observe/costmodel.py):
+    FLOPs, bytes accessed, per-step time, MFU / HBM-bandwidth
+    utilization, and the compute/bandwidth/host-bound verdict for every
+    compiled program the run captured;
+  * **numerics** — the health timeline (observe/numerics.py): probe
+    summaries, loss spikes/divergence, non-finite detections;
   * **resilience timeline** — retries, breaker transitions, chaos
     injections, preemption/resume, ordered by timestamp;
   * **counters** — the run's counter deltas.
@@ -93,6 +104,11 @@ def build_report(events: list[dict], top: int = 5) -> dict:
     resilience = sorted((e for e in instants + spans
                          if e.get("cat") == "resilience"),
                         key=lambda e: e["ts"])
+    numerics = sorted(
+        (e for e in instants
+         if e.get("cat") == "numerics"
+         or str(e.get("name", "")).startswith("numerics.")),
+        key=lambda e: e["ts"])
     from mmlspark_tpu.observe.trace import aggregate_spans
     return {
         "wall_s": wall_s,
@@ -102,9 +118,37 @@ def build_report(events: list[dict], top: int = 5) -> dict:
         "span_aggregates": aggregate_spans(spans),
         "slowest_steps": steps[:top],
         "recompiles": recompiles,
+        "programs": _programs(events),
+        "numerics": numerics,
         "resilience": resilience,
         "counters": counters,
     }
+
+
+def _programs(events: list[dict]) -> dict:
+    """The per-program roofline table: the sealed `programs` event when
+    the run finished cleanly; for a torn run, a degraded table rebuilt
+    from the `program_cost` capture events (costs without times)."""
+    table = {}
+    for ev in events:
+        if ev.get("type") == "programs":
+            table = ev.get("programs", {})
+    if table:
+        return table
+    for ev in events:
+        if ev.get("type") == "event" and ev.get("name") == "program_cost":
+            a = ev.get("attrs", {})
+            key = f"{a.get('where')}:{a.get('program')}"
+            table[key] = {
+                "where": a.get("where"), "program": a.get("program"),
+                "flops": a.get("flops"),
+                "bytes_accessed": a.get("bytes_accessed"),
+                "executions": 0, "span_s": 0.0,
+                "step_s": a.get("probe_step_s"), "step_basis": "probe",
+                "mfu": None, "hbm_bw_util": None, "bound": None,
+                "verdict": None,
+            }
+    return table
 
 
 def _attrs_str(attrs: dict) -> str:
@@ -146,6 +190,45 @@ def render_report(report: dict) -> str:
         lines.append("  (none recorded)")
 
     lines.append("")
+    progs = report.get("programs", {})
+    lines.append(f"-- roofline: compiled programs ({len(progs)}) --")
+    for key in sorted(progs):
+        p = progs[key]
+        flops = p.get("flops")
+        step_s = p.get("step_s")
+        parts = [f"  {key}"]
+        parts.append(f"    {p.get('executions', 0)} execution(s)"
+                     + (f", {step_s * 1e3:.3f} ms/step "
+                        f"({p.get('step_basis')})" if step_s else ""))
+        if flops:
+            parts.append(
+                f"    {flops:.3e} FLOPs, "
+                + (f"{p['bytes_accessed']:.3e} bytes"
+                   if p.get("bytes_accessed") else "bytes n/a")
+                + (f", AI {p['arithmetic_intensity']:g}"
+                   if p.get("arithmetic_intensity") else ""))
+        util = []
+        if p.get("mfu") is not None:
+            util.append(f"MFU {p['mfu']:.4f}")
+        if p.get("hbm_bw_util") is not None:
+            util.append(f"HBM bw {p['hbm_bw_util']:.4f}")
+        verdict = p.get("verdict")
+        parts.append("    " + (", ".join(util) + ", " if util else "")
+                     + f"verdict: {verdict if verdict else 'unknown (no device peaks)'}")
+        lines.extend(parts)
+    if not progs:
+        lines.append("  (no program costs captured)")
+
+    lines.append("")
+    numerics = report.get("numerics", [])
+    lines.append(f"-- numerics health ({len(numerics)}) --")
+    for e in numerics:
+        lines.append(f"  @{e['ts']:.3f}s {e['name']} "
+                     f"{_attrs_str(e.get('attrs', {}))}")
+    if not numerics:
+        lines.append("  (no probes recorded)")
+
+    lines.append("")
     lines.append(f"-- resilience timeline ({len(report['resilience'])}) --")
     for e in report["resilience"]:
         lines.append(f"  @{e['ts']:.3f}s {e['name']} "
@@ -168,12 +251,20 @@ def main(argv: Optional[list] = None) -> int:
     parser.add_argument("run", help="run directory or run.jsonl path")
     parser.add_argument("--top", type=int, default=5,
                         help="slowest steps to list (default 5)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="json prints the structured report dict "
+                             "(machine-readable, for CI)")
     args = parser.parse_args(argv)
     events = load_run(args.run)
     if not events:
         print(f"no events in {args.run}")
         return 1
-    print(render_report(build_report(events, top=args.top)))
+    report = build_report(events, top=args.top)
+    if args.format == "json":
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    else:
+        print(render_report(report))
     return 0
 
 

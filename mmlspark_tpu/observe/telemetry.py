@@ -39,6 +39,7 @@ import contextlib
 import contextvars
 import json
 import os
+import threading
 import time
 from typing import Iterator, Optional
 
@@ -80,6 +81,13 @@ class RunTelemetry:
         self.timings = PipelineTimings()
         self._counters0 = counters_snapshot() if live else {}
         self._gauges: dict[str, dict] = {}
+        # per-program cost/time tables (observe/costmodel.py): costs from
+        # compile-time cost_analysis capture, times accumulated by the hot
+        # loops at each execution, keyed (where, program) on both sides so
+        # the roofline join is by construction
+        self._program_costs: dict[tuple, dict] = {}
+        self._program_times: dict[tuple, dict] = {}
+        self._program_lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._finished: Optional[dict] = None
         if live:
@@ -134,6 +142,44 @@ class RunTelemetry:
                     self.gauge(name, stats[key], tag=tag)
         return out
 
+    # -- per-program cost/time (roofline attribution) ---------------------
+    def record_program_cost(self, where: str, program: str,
+                            rec: dict) -> None:
+        """One compiled program's cost row (capture_program_cost writes
+        here; first capture wins — the program's cost never changes)."""
+        if not self.live:
+            return
+        with self._program_lock:
+            self._program_costs.setdefault((where, str(program)), dict(rec))
+
+    def add_program_time(self, where: str, program: str, seconds: float,
+                         basis: str = "dispatch") -> None:
+        """Accumulate one execution's seconds against a program.  `basis`
+        says what the seconds measure: 'step_wall' (the span bracketed a
+        synced execution — trainer steps) or 'dispatch' (async dispatch
+        only — scoring/decode, whose roofline uses the capture probe)."""
+        if not self.live:
+            return
+        with self._program_lock:
+            t = self._program_times.setdefault(
+                (where, str(program)),
+                {"seconds": 0.0, "count": 0, "basis": basis})
+            t["seconds"] += seconds
+            t["count"] += 1
+
+    def program_summary(self) -> dict:
+        """The per-program roofline table (costmodel.program_summary over
+        this run's cost/time tables + the device peaks)."""
+        from mmlspark_tpu.observe.costmodel import (device_peaks,
+                                                    program_summary)
+        if not (self._program_costs or self._program_times):
+            return {}
+        peak_flops, peak_bw = device_peaks()
+        with self._program_lock:
+            costs = {k: dict(v) for k, v in self._program_costs.items()}
+            times = {k: dict(v) for k, v in self._program_times.items()}
+        return program_summary(costs, times, peak_flops, peak_bw)
+
     # -- counters ---------------------------------------------------------
     def counter_deltas(self) -> dict[str, float]:
         """Counter movement since the block was entered (only counters
@@ -158,6 +204,7 @@ class RunTelemetry:
             "gauges": self.gauges(),
             "spans": self.tracer.span_aggregates(),
             "stage_timings": self.timings.summary(),
+            "programs": self.program_summary(),
             "trace_records_dropped": self.tracer.dropped,
         }
 
@@ -178,6 +225,11 @@ class RunTelemetry:
                              "seconds": {k: round(v, 6) for k, v in
                                          self.timings.seconds.items()},
                              "summary": summary["stage_timings"]})
+        if summary["programs"]:
+            # the joined roofline table rides the stream too, so the
+            # report CLI renders verdicts from run.jsonl alone
+            self.tracer._record({"type": "programs", "ts": ts,
+                                 "programs": summary["programs"]})
         self.tracer._record({"type": "run_end", "ts": ts,
                              "wall_s": summary["wall_s"]})
         self.tracer.close()

@@ -57,6 +57,11 @@ CHAOS_PREEMPT_AT_STEP = config.register(
     "MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", 0,
     "chaos injector: deliver one simulated SIGTERM when training reaches "
     "this global step (0 = off)", ptype=int)
+CHAOS_NAN_AT_STEP = config.register(
+    "MMLSPARK_TPU_CHAOS_NAN_AT_STEP", 0,
+    "chaos injector: poison one training step's loss mask with NaN when "
+    "training reaches this global step (0 = off) — the numerics-probe / "
+    "halt_on_nonfinite drill (observe/numerics.py)", ptype=int)
 
 
 class InjectedNetworkError(ConnectionError):
@@ -75,7 +80,8 @@ class ChaosInjector:
                  stall_rate: Optional[float] = None,
                  stall_s: Optional[float] = None,
                  torn_ckpt_rate: Optional[float] = None,
-                 preempt_at_step: Optional[int] = None):
+                 preempt_at_step: Optional[int] = None,
+                 nan_at_step: Optional[int] = None):
         read = lambda explicit, var, cast: cast(
             var.current() if explicit is None else explicit)
         self.net_error_rate = read(net_error_rate, CHAOS_NET_ERROR_RATE, float)
@@ -83,13 +89,16 @@ class ChaosInjector:
         self.stall_s = read(stall_s, CHAOS_STALL_S, float)
         self.torn_ckpt_rate = read(torn_ckpt_rate, CHAOS_TORN_CKPT_RATE, float)
         self.preempt_at_step = read(preempt_at_step, CHAOS_PREEMPT_AT_STEP, int)
+        self.nan_at_step = read(nan_at_step, CHAOS_NAN_AT_STEP, int)
         self._rng = random.Random(read(seed, CHAOS_SEED, int))
         self._preempt_fired = False
+        self._nan_fired = False
 
     @property
     def active(self) -> bool:
         return bool(self.net_error_rate or self.stall_rate
-                    or self.torn_ckpt_rate or self.preempt_at_step)
+                    or self.torn_ckpt_rate or self.preempt_at_step
+                    or self.nan_at_step)
 
     # -- network hazards -------------------------------------------------
     def on_request(self, url: str) -> None:
@@ -139,6 +148,23 @@ class ChaosInjector:
             get_logger("resilience").warning(
                 "chaos: raising simulated SIGTERM at step %d", step)
             signal.raise_signal(signal.SIGTERM)
+
+    # -- numerics hazards --------------------------------------------------
+    def poison_nan(self, step: int) -> bool:
+        """True exactly once, when `step` reaches the configured NaN
+        injection point; the trainer then multiplies the step's loss mask
+        by NaN (dtype-agnostic — poisons float and token models alike),
+        so loss, gradients, and the updated params all go non-finite —
+        the drill the numerics probe and halt_on_nonfinite exist for."""
+        if (self.nan_at_step and not self._nan_fired
+                and step >= self.nan_at_step):
+            self._nan_fired = True
+            inc_counter("chaos.nan_injections")
+            trace_event("chaos.nan_injection", cat="resilience", step=step)
+            get_logger("resilience").warning(
+                "chaos: poisoning step %d loss mask with NaN", step)
+            return True
+        return False
 
 
 _injector: Optional[ChaosInjector] = None

@@ -64,6 +64,16 @@ class TrainerConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every_steps: int = 0        # 0 = only at end
 
+    # numerics health (observe/numerics.py): every `numerics_cadence`
+    # steps the jitted probe reports non-finite counts and per-layer-group
+    # grad/param/update-ratio norms, and the rolling loss-spike detector
+    # sees that step's loss (0 = off).  Off-cadence steps pay only a
+    # lax.cond predicate.  `halt_on_nonfinite` raises NonFiniteError at
+    # the step boundary BEFORE any checkpoint write, so a poisoned state
+    # never rotates over the last finite checkpoint.
+    numerics_cadence: int = 50
+    halt_on_nonfinite: bool = False
+
     # weight on model-sown auxiliary losses (flax "losses" collection,
     # e.g. the MoE load-balance term); 0 ignores the sown values
     aux_loss_weight: float = 0.0

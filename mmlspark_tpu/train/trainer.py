@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import inspect
 import os
-import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -37,7 +36,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from mmlspark_tpu.models.bundle import ModelBundle, _to_plain
 from mmlspark_tpu.models.definitions import build_model
 from mmlspark_tpu.observe import MetricData, get_logger
-from mmlspark_tpu.observe.spans import active_timings, span_on
+from mmlspark_tpu.observe.costmodel import capture_program_cost
+from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.observe.numerics import (LossSpikeDetector, NonFiniteError,
+                                           tree_health)
+from mmlspark_tpu.observe.spans import active_timings, monotonic, span_on
+from mmlspark_tpu.observe.telemetry import active_run
 from mmlspark_tpu.observe.trace import (active_tracer, current_span_id,
                                         span_on_tracer, trace_event,
                                         trace_span)
@@ -325,8 +329,14 @@ class Trainer:
         tx = self._tx
 
         aux_w = float(self.config.aux_loss_weight)
+        # numerics health (observe/numerics.py): when the probe cadence is
+        # on, the step takes a traced `probe` flag and returns the health
+        # dict under lax.cond — off-cadence steps pay one predicate, the
+        # reductions only run on probe steps, and the step stays ONE
+        # compiled program either way
+        with_health = self.config.numerics_cadence > 0
 
-        def train_step(state: TrainState, x, y, mask):
+        def train_step(state: TrainState, x, y, mask, probe=False):
             def compute(params):
                 variables = {"params": params}
                 if state.batch_stats:
@@ -347,10 +357,11 @@ class Trainer:
                     loss = loss + aux_w * sum(
                         jnp.asarray(v).sum() for v in
                         jax.tree_util.tree_leaves(mut.get("losses", {})))
-                return loss, (new_stats, _fold_metrics(mut.get("metrics", {})))
+                return loss, (new_stats,
+                              _fold_metrics(mut.get("metrics", {})), out)
 
-            (loss, (new_stats, metrics)), grads = jax.value_and_grad(
-                compute, has_aux=True)(state.params)
+            (loss, (new_stats, metrics, logits)), grads = \
+                jax.value_and_grad(compute, has_aux=True)(state.params)
             # the global gradient norm joins the per-step diagnostics (one
             # tree reduction under jit — noise next to the backward pass);
             # history gains a grad_norm column and telemetry step spans
@@ -360,8 +371,21 @@ class Trainer:
             new_params = optax.apply_updates(state.params, updates)
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt, batch_stats=new_stats)
+            if with_health:
+                def probed():
+                    return tree_health(new_params, grads, updates,
+                                       acts=logits)
+
+                metrics["health"] = jax.lax.cond(
+                    probe, probed,
+                    lambda: {k: jnp.zeros((), jnp.float32)
+                             for k in jax.eval_shape(probed)})
             return new_state, loss, metrics
 
+        if not with_health:
+            def plain_step(state, x, y, mask):
+                return train_step(state, x, y, mask)
+            return jax.jit(plain_step, donate_argnums=(0,))
         return jax.jit(train_step, donate_argnums=(0,))
 
     # -- the loop --------------------------------------------------------
@@ -470,7 +494,7 @@ class Trainer:
 
         # distinct per-process streams so partitions shuffle independently
         rng = np.random.default_rng(cfg.seed + jax.process_index())
-        t0 = time.perf_counter()
+        t0 = monotonic()
         # host-side counter seeded once from this run's base step so
         # checkpoint_every_steps boundaries stay aligned across fit()
         # calls; never sync on state.step mid-epoch.  On resume it replays
@@ -494,12 +518,23 @@ class Trainer:
         # the staging closure by value — the same capture-by-closure rule
         # as `timings` above, since worker threads never inherit contextvars
         tracer = active_tracer()
+        run = active_run()  # the run's cost/gauge tables (same capture rule)
         fit_span = tracer.span(
             "train.fit", parent=current_span_id(), cat="phase",
             architecture=cfg.architecture, total_steps=total_steps,
             batch_size=bs, resume_from=skip_until - base_step or 0,
         ) if tracer is not None else None
         fit_id = fit_span.span_id if fit_span is not None else None
+        # numerics health (observe/numerics.py): probe every `cadence`
+        # executed steps; the loss-spike detector sees the probe steps'
+        # losses; halt_on_nonfinite raises before any checkpoint write.
+        # Detection granularity IS the cadence — keep it at or below
+        # checkpoint_every_steps so a poisoned state cannot slip into a
+        # rotation between probes.
+        cadence = max(0, int(cfg.numerics_cadence)) if not self._pp else 0
+        detector = LossSpikeDetector() if cadence else None
+        self.last_health: Optional[dict] = None
+        prog_key: Optional[str] = None
 
         def plan():
             step_c = base_step
@@ -548,7 +583,7 @@ class Trainer:
             epoch_loss = float(np.sum(jax.device_get(losses)))
             rec = {"epoch": cur_epoch,
                    "loss": epoch_loss / max(n_batches, 1),
-                   "wall_s": time.perf_counter() - t0}
+                   "wall_s": monotonic() - t0}
             if step_metrics:
                 # model-sown diagnostics (e.g. MoE overflow fraction)
                 # averaged over the epoch's steps, one history column each
@@ -573,10 +608,28 @@ class Trainer:
                         cur_epoch = epoch
                         losses, step_metrics = [], []
                     chaos.on_step(step_c)  # may deliver simulated SIGTERM
+                    if chaos.poison_nan(step_c):
+                        # dtype-agnostic poison: a NaN loss mask drives
+                        # the loss, gradients, and update non-finite —
+                        # the numerics-probe drill
+                        mask_d = mask_d * jnp.nan
+                    probe_now = bool(cadence) and step_c % cadence == 0
+                    step_args = (state, xb, yb, mask_d) + \
+                        ((probe_now,) if cadence else ())
+                    if prog_key is None:
+                        prog_key = f"{tuple(xb.shape)}:{xb.dtype}"
+                    if run is not None and first_exec:
+                        # compile-time cost capture (observe/costmodel.py)
+                        # BEFORE the first execution — the step donates
+                        # its state, so lowering afterwards would see
+                        # deleted buffers.  One AOT compile per run, and
+                        # never a probe execution (donation).
+                        capture_program_cost(step_fn, step_args,
+                                             where="trainer",
+                                             program=prog_key, run=run)
                     if tracer is None:
                         with span_on(timings, "compute"):
-                            state, loss, metrics = step_fn(state, xb, yb,
-                                                           mask_d)
+                            state, loss, metrics = step_fn(*step_args)
                     else:
                         # per-step span: the scalar fetches force the step
                         # to FINISH inside the span, so its wall is the
@@ -587,8 +640,7 @@ class Trainer:
                                 step=step_c, epoch=epoch,
                                 first_step_compile=first_exec) as sp, \
                                 span_on(timings, "compute"):
-                            state, loss, metrics = step_fn(state, xb, yb,
-                                                           mask_d)
+                            state, loss, metrics = step_fn(*step_args)
                             sp.attrs["loss"] = float(jax.device_get(loss))
                             if "grad_norm" in metrics:
                                 sp.attrs["grad_norm"] = float(
@@ -597,10 +649,22 @@ class Trainer:
                             if dur > 0:
                                 sp.attrs["rows_per_sec"] = round(
                                     bs_local / dur, 1)
+                        if run is not None:
+                            # synced step spans are true walls — the
+                            # roofline joins them directly
+                            run.add_program_time("trainer", prog_key, dur,
+                                                 basis="step_wall")
                     first_exec = False
+                    health = metrics.pop("health", None) if cadence else None
                     losses.append(loss)  # device array; fetched at epoch end
                     if metrics:
                         step_metrics.append(metrics)
+                    if probe_now and health is not None:
+                        # may raise NonFiniteError — BEFORE the
+                        # step-boundary checkpoint below, so a poisoned
+                        # state never rotates over the last finite one
+                        self._numerics_check(step_c, loss, health,
+                                             detector, run, ckpt_dir)
                     step = step_c + 1
                     if ckpt_dir and cfg.checkpoint_every_steps and \
                             step % cfg.checkpoint_every_steps == 0:
@@ -635,6 +699,49 @@ class Trainer:
         self.training_metric_data().log("train", "debug")
         self._last_state = state  # inspectable (sharding asserts, resume)
         return self.bundle_from_state(state)
+
+    def _numerics_check(self, step: int, loss, health: dict, detector,
+                        run, ckpt_dir: Optional[str]) -> None:
+        """One probe-step health pass (observe/numerics.py): fetch the
+        jitted probe's scalars, feed the loss-spike detector, emit
+        resilience-style events, and — with halt_on_nonfinite armed —
+        raise NonFiniteError before any checkpoint write."""
+        fetched = {k: float(v)
+                   for k, v in jax.device_get(health).items()}
+        loss_val = float(jax.device_get(loss))
+        self.last_health = {"step": step, "loss": loss_val, **fetched}
+        nonfinite = (fetched.get("nonfinite_params", 0.0)
+                     + fetched.get("nonfinite_grads", 0.0)
+                     + fetched.get("nonfinite_acts", 0.0)
+                     + (0.0 if np.isfinite(loss_val) else 1.0))
+        verdict = detector.update(loss_val) if detector is not None \
+            else "ok"
+        if run is not None:
+            for key, value in fetched.items():
+                run.gauge(f"numerics.{key}", value, step=step)
+        trace_event("numerics.probe", cat="numerics", step=step,
+                    loss=loss_val, verdict=verdict,
+                    nonfinite_elements=nonfinite)
+        if nonfinite:
+            inc_counter("numerics.nonfinite_probes")
+            trace_event("numerics.nonfinite", cat="resilience", step=step,
+                        loss=loss_val, nonfinite_elements=nonfinite,
+                        halting=bool(self.config.halt_on_nonfinite))
+            get_logger("train").warning(
+                "numerics: non-finite training state at step %d "
+                "(%g element(s), loss=%g)", step, nonfinite, loss_val)
+            if self.config.halt_on_nonfinite:
+                raise NonFiniteError(
+                    step, f"{nonfinite:g} non-finite element(s), "
+                          f"loss={loss_val:g}", ckpt_dir)
+        elif verdict in ("spike", "divergence"):
+            inc_counter(f"numerics.loss_{verdict}")
+            trace_event(f"numerics.loss_{verdict}", cat="resilience",
+                        step=step, loss=loss_val,
+                        threshold=detector.threshold())
+            get_logger("train").warning(
+                "numerics: loss %s at step %d (loss=%g, threshold=%g)",
+                verdict, step, loss_val, detector.threshold())
 
     def training_metric_data(self) -> MetricData:
         """This trainer's history as a typed metric table (loss/wall plus

@@ -96,6 +96,43 @@ def forward_flops(bundle, input_shape: tuple, dtype=np.float32) -> Optional[floa
         return None
 
 
+def lm_train_flops(batch: int, seq: int, d_model: int, n_layers: int,
+                   vocab_size: int, *, causal: bool = True,
+                   attn_impl: str = "flash", mlp_ratio: int = 4) -> dict:
+    """Analytic TransformerLM train-step FLOPs, split so the XLA
+    cross-check is well-defined (the ONE accounting bench.py and the
+    perf-floor tests share).
+
+      * `dense` — 6 x tokens x N_linear (fwd + 2x bwd over the QKVO
+        projections, the MLP pair, and the vocab head);
+      * `attn` — the mathematically REQUIRED attention matmuls: 2 forward
+        (QK^T, PV) + 4 backward (dV = P^T dO, dP = dO V^T, dQ = dS K,
+        dK = dS^T Q), each 2*B*S^2*d FLOPs dense, HALVED under a causal
+        mask (only the lower triangle is required work).  Kernel-side
+        recompute — the split flash backward re-issuing S and dP — is
+        overhead, not useful work, and is NOT counted: reported MFU stays
+        conservative relative to hardware utilization;
+      * `total` = dense + attn — the MFU denominator's numerator;
+      * `xla_visible` — what `compiled.cost_analysis()` can see: pallas
+        kernels are opaque to XLA, so the flash path's visible FLOPs are
+        the dense part alone; a dense attn_impl EXECUTES the full (and
+        fully counted) S^2 matmuls, mask or no mask.
+
+    `xla_flops / xla_visible` ≈ 1 is the agreement check that keeps the
+    analytic model honest (test_perf_floor.py); the old single-number
+    comparison read the pallas blindness as a mystery ~40% discrepancy
+    on the 8k arm.
+    """
+    n_linear = (n_layers * (4 + 2 * mlp_ratio) * d_model * d_model
+                + d_model * vocab_size)
+    dense = 6 * batch * seq * n_linear
+    attn_full = 6 * 2 * n_layers * batch * seq * seq * d_model
+    attn = attn_full // 2 if causal else attn_full
+    xla_visible = dense if attn_impl == "flash" else dense + attn_full
+    return {"dense": dense, "attn": attn, "attn_full": attn_full,
+            "total": dense + attn, "xla_visible": xla_visible}
+
+
 def mfu(images_per_sec: float, flops_per_image: Optional[float],
         device: Optional[Any] = None) -> Optional[float]:
     """Model-FLOPs utilization of one chip at `images_per_sec`; None when

@@ -116,4 +116,39 @@ def api_summary() -> str:
                 default = repr(p.default) if p.has_default else "(required)"
                 lines.append(f"| `{name}` | `{default}` | {p.doc} |")
         lines.append("")
+    lines.extend(_config_summary())
     return "\n".join(lines)
+
+
+def _config_summary() -> list:
+    """The MMLSPARK_TPU_* configuration registry as a reference table.
+
+    Every module that declares config variables is imported first, so the
+    registry is fully populated regardless of what the caller already
+    loaded (the registry is fed at import time, one declaration each).
+    """
+    import importlib
+
+    from mmlspark_tpu import config
+    for mod in ("mmlspark_tpu.observe.costmodel",
+                "mmlspark_tpu.observe.history",
+                "mmlspark_tpu.parallel.prefetch",
+                "mmlspark_tpu.io.remote",
+                "mmlspark_tpu.resilience.retry",
+                "mmlspark_tpu.resilience.breaker",
+                "mmlspark_tpu.resilience.chaos",
+                "mmlspark_tpu.resilience.checkpoints"):
+        importlib.import_module(mod)
+    lines = ["## Configuration registry (`mmlspark_tpu.config`)", "",
+             "Every `MMLSPARK_TPU_*` environment variable, declared once "
+             "with its default and doc (`config.describe()` at runtime; "
+             "precedence: `config.set()` override > environment > "
+             "default).", "",
+             "| variable | default | doc |", "|---|---|---|"]
+    for var in config.describe():
+        if not var["declared_by"].startswith("mmlspark_tpu"):
+            continue  # test/application declarations made in-process
+        doc = " ".join(str(var["doc"]).split())
+        lines.append(f"| `{var['name']}` | `{var['default']!r}` | {doc} |")
+    lines.append("")
+    return lines

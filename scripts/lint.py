@@ -22,6 +22,12 @@ Checks, per file:
     logger factory (`observe.logging.get_logger`), so the whole package
     stays silenceable/redirectable from one knob; `observe/report.py` is
     whitelisted (it IS the CLI whose product is stdout text)
+  * raw `time.time()` / `time.perf_counter()` (and friends) in hot-loop
+    modules — fine-grained timing on the scoring/training/decode paths
+    must ride the `observe` span machinery (span_on / trace_span /
+    pipeline stage spans), so every measured second is attributed and
+    exported; the one sanctioned coarse clock is
+    `observe.spans.monotonic` (epoch wall fields)
   * implicit float64 promotion in hot-loop modules — `np.float64`/
     `np.double` references, and `asarray`/`array` calls whose argument is
     a bare python list/tuple literal (or comprehension) with no dtype:
@@ -69,7 +75,13 @@ HOT_LOOP_DIRS = {
 PACKAGE_DIR = "mmlspark_tpu"
 PRINT_WHITELIST = {
     os.path.join("mmlspark_tpu", "observe", "report.py"),
+    os.path.join("mmlspark_tpu", "observe", "history.py"),
 }
+
+# raw clock reads forbidden in hot-loop modules (route through observe
+# spans; observe.spans.monotonic is the sanctioned coarse clock)
+_TIME_ATTRS = ("time", "perf_counter", "monotonic", "process_time",
+               "perf_counter_ns", "monotonic_ns")
 ROOT_LOGGER_METHODS = ("debug", "info", "warning", "error", "critical",
                        "exception", "log", "basicConfig")
 
@@ -92,6 +104,19 @@ def _is_device_put_call(node: ast.Call) -> bool:
     if isinstance(fn, ast.Name):
         return fn.id == "device_put"
     return isinstance(fn, ast.Attribute) and fn.attr == "device_put"
+
+
+def _is_raw_time_call(node: ast.Call) -> bool:
+    """Matches `time.time()` / `time.perf_counter()` etc, and the bare
+    `perf_counter()` / `process_time()` forms from `from time import
+    ...` (a bare `monotonic()` is NOT matched — that is the sanctioned
+    observe.spans.monotonic clock)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id in ("perf_counter", "process_time",
+                         "perf_counter_ns", "monotonic_ns")
+    return (isinstance(fn, ast.Attribute) and fn.attr in _TIME_ATTRS
+            and isinstance(fn.value, ast.Name) and fn.value.id == "time")
 
 
 def _is_f64_literal_asarray(node: ast.Call) -> bool:
@@ -229,6 +254,13 @@ def check_file(path: str) -> list[str]:
                 f"module — transfers go through parallel/bridge.py "
                 f"(put_sharded/shard_batch/put_tree/reshard) or "
                 f"parallel/prefetch.py staging")
+        if in_hot_loop and isinstance(node, ast.Call) \
+                and _is_raw_time_call(node):
+            problems.append(
+                f"{path}:{node.lineno}: raw time.* clock read in a "
+                f"hot-loop module — timing there must ride the observe "
+                f"span machinery (span_on/trace_span); the sanctioned "
+                f"coarse clock is observe.spans.monotonic")
         if in_hot_loop and isinstance(node, ast.Call) \
                 and _is_f64_literal_asarray(node):
             problems.append(

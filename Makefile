@@ -1,6 +1,6 @@
 # Developer entry points (the reference's `runme` + sbt targets,
 # tools/runme/runme.sh:30-52 + src/project/build.scala).
-.PHONY: check check-full test test-full lint bench bench-smoke bench-history tpu-floors install docs notebooks clean
+.PHONY: check check-full test test-full lint bench bench-smoke bench-history chaos-drill tpu-floors install docs notebooks clean
 
 check:            ## full gate: syntax + lint + suite + dryrun + bench smoke
 	bash scripts/check.sh
@@ -28,6 +28,9 @@ bench-smoke:      ## lint + tiny-size bench incl. quantized + telemetry-overhead
 bench-history:    ## append a full bench run to the local history store and print verdicts
 	python bench.py | tee /tmp/mmlspark_tpu_bench.json
 	python -m mmlspark_tpu.observe.history ingest /tmp/mmlspark_tpu_bench.json
+
+chaos-drill:      ## run the multi-fault chaos scenario suite end-to-end (NaN rollback, torn rotation, hung step, budget exhaustion)
+	python scripts/chaos_drill.py
 
 tpu-floors:       ## throughput/MFU floors on a real TPU chip
 	MMLSPARK_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_perf_floor.py -q

@@ -48,6 +48,29 @@ def check_on_error(policy: str) -> str:
     return policy
 
 
+def record_skipped_rows(stage: str, count: int,
+                        reason: str = "on_error=skip") -> None:
+    """Make `on_error='skip'` row drops VISIBLE at the run level.
+
+    Graceful degradation that is silent is data loss with extra steps:
+    a reader quietly shrinking batches looks identical to a smaller
+    corpus.  Every skip site (the image readers, row-wise transforms)
+    reports its drop count here — one `rows.skipped_on_error` process
+    counter (lands in run_summary counter deltas) plus a cat=resilience
+    trace event (lands in the run-report resilience timeline) and a
+    warning, so a run that lost rows says so in every surface."""
+    if count <= 0:
+        return
+    from mmlspark_tpu.observe.logging import get_logger
+    from mmlspark_tpu.observe.metrics import inc_counter
+    from mmlspark_tpu.observe.trace import trace_event
+    inc_counter("rows.skipped_on_error", float(count))
+    trace_event("rows.skipped", cat="resilience", stage=stage,
+                rows=int(count), reason=reason)
+    get_logger("core").warning("%s: skipped %d row(s) (%s)", stage,
+                               count, reason)
+
+
 def _fresh_uid(cls_name: str) -> str:
     return f"{cls_name}_{next(_uid_counters):04d}"
 

@@ -17,7 +17,7 @@ import numpy as np
 
 from typing import Iterator
 
-from mmlspark_tpu.core.pipeline import check_on_error
+from mmlspark_tpu.core.pipeline import check_on_error, record_skipped_rows
 from mmlspark_tpu.core.schema import ColumnMeta, ImageSchema
 from mmlspark_tpu.core.table import DataTable, object_column
 from mmlspark_tpu.io.files import iter_binary_files, read_binary_files
@@ -127,10 +127,12 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
                               inspect_zip=inspect_zip, pattern=pattern,
                               seed=seed)
     paths, images, errors = [], [], []
+    skipped = 0
     decoded = decode_many(list(files["bytes"]))
     for p, img in zip(files["path"], decoded):
         if img is None:
             if policy == "skip":
+                skipped += 1
                 continue
             if policy == "fail":
                 raise ValueError(f"could not decode image: {p}")
@@ -141,6 +143,8 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
         images.append(img)
         paths.append(p)
         errors.append(None)
+    # skipped rows are never silent at the run level: counter + event
+    record_skipped_rows("read_images", skipped, "undecodable image")
 
     if policy == "column":
         shapes = [img.shape for img in images if img is not None]
@@ -243,9 +247,11 @@ def read_images_iter(path: str, batch_size: int = 256,
 
     def absorb(batch_paths: list, decoded: list) -> None:
         nonlocal first_shape
+        skipped = 0
         for p, img in zip(batch_paths, decoded):
             if img is None:
                 if policy == "skip":
+                    skipped += 1
                     continue
                 if policy == "fail":
                     raise ValueError(f"could not decode image: {p}")
@@ -271,6 +277,9 @@ def read_images_iter(path: str, batch_size: int = 256,
                         f"{first_shape}")
             paths.append(p)
             images.append(img)
+        # per decode-batch, on the consumer thread (row-order preserved)
+        record_skipped_rows("read_images_iter", skipped,
+                            "undecodable image")
 
     def flush(k: int) -> DataTable:
         nonlocal paths, images, errors

@@ -129,6 +129,32 @@ class NonFiniteError(RuntimeError):
         super().__init__(msg)
 
 
+class DivergenceError(RuntimeError):
+    """The loss-spike detector returned a `divergence` verdict and
+    halt_on_divergence is armed.
+
+    Raised at the step boundary BEFORE any checkpoint write (same
+    contract as NonFiniteError) so the newest checkpoint on disk is the
+    last pre-divergence state — the restore point the recovery
+    supervisor rolls back to.
+    """
+
+    def __init__(self, step: int, loss: float,
+                 threshold: Optional[float] = None,
+                 ckpt_dir: Optional[str] = None):
+        self.step = step
+        self.loss = loss
+        self.threshold = threshold
+        self.ckpt_dir = ckpt_dir
+        msg = (f"loss divergence detected at step {step} (loss={loss:g}"
+               + (f", spike threshold {threshold:g}" if threshold
+                  is not None else "") + ")")
+        if ckpt_dir:
+            msg += (f"; halting before the diverged state reaches a "
+                    f"checkpoint — restore from {ckpt_dir}")
+        super().__init__(msg)
+
+
 class LossSpikeDetector:
     """Rolling loss-health verdicts: ok | spike | divergence | nonfinite.
 

@@ -88,6 +88,10 @@ class RunTelemetry:
         self._program_costs: dict[tuple, dict] = {}
         self._program_times: dict[tuple, dict] = {}
         self._program_lock = threading.Lock()
+        # the run's recovery timeline (train/supervisor.py): one ordered
+        # dict per supervisor event (failure / recover / completed /
+        # gave_up ...), surfaced machine-readable in run_summary.json
+        self._recovery: list[dict] = []
         self._t0 = time.perf_counter()
         self._finished: Optional[dict] = None
         if live:
@@ -180,6 +184,20 @@ class RunTelemetry:
             times = {k: dict(v) for k, v in self._program_times.items()}
         return program_summary(costs, times, peak_flops, peak_bw)
 
+    # -- recovery timeline -------------------------------------------------
+    def record_recovery(self, event: dict) -> None:
+        """Append one recovery-supervisor event to the run's timeline
+        (also streamed as a `recovery` record so run.jsonl replays it);
+        the full ordered list lands in run_summary.json under
+        `recovery` — the machine-readable account of every rollback,
+        skip window, and budget decision the run took."""
+        if not self.live:
+            return
+        rec = dict(event)
+        self._recovery.append(rec)
+        self.tracer._record({"type": "recovery",
+                             "ts": round(self.tracer.now(), 6), **rec})
+
     # -- counters ---------------------------------------------------------
     def counter_deltas(self) -> dict[str, float]:
         """Counter movement since the block was entered (only counters
@@ -205,6 +223,7 @@ class RunTelemetry:
             "spans": self.tracer.span_aggregates(),
             "stage_timings": self.timings.summary(),
             "programs": self.program_summary(),
+            "recovery": [dict(e) for e in self._recovery],
             "trace_records_dropped": self.tracer.dropped,
         }
 

@@ -117,6 +117,26 @@ def gather_replicated(tree: Any, mesh: Mesh) -> Any:
     return _gather_fns[mesh](tree)
 
 
+_snapshot_fn = None
+
+
+def snapshot_tree(tree: Any) -> Any:
+    """A defensive on-device copy with UNCHANGED shardings.
+
+    The async-checkpoint snapshot (train/trainer.py): the writer thread
+    device_gets the copy at its leisure, so the step loop donating the
+    live state buffers to the next step never invalidates a pending
+    write.  Single-process only — every shard is addressable, so no
+    replication (cost: one device-local copy of the state bytes, not
+    n_devices copies); multi-host saves keep `gather_replicated`, which
+    the coordinator needs for addressability anyway.
+    """
+    global _snapshot_fn
+    if _snapshot_fn is None:
+        _snapshot_fn = jax.jit(lambda t: t)  # identity jit = fresh buffers
+    return _snapshot_fn(tree)
+
+
 def gather_to_host(tree: Any, mesh: Mesh) -> Any:
     """Fetch a pytree of (possibly cross-process sharded) arrays to host."""
     if jax.process_count() == 1:
@@ -145,6 +165,16 @@ def put_like(new: Any, old: Any) -> Any:
     if hasattr(old, "sharding"):
         return jax.device_put(new, old.sharding)
     return new
+
+
+def put_tree_like(new_tree: Any, like_tree: Any) -> Any:
+    """Reshard-on-restore: commit a host pytree onto the shardings of a
+    live tree built for the CURRENT mesh.  Checkpoints store gathered
+    (full logical shape) arrays, so their global shapes are
+    device-count-independent — a state saved under dp=N lands correctly
+    on an M-device mesh because the target layout comes from the live
+    state, never from the file (elastic resume, train/trainer.py)."""
+    return jax.tree_util.tree_map(put_like, new_tree, like_tree)
 
 
 def replicate_tree(tree: Any, mesh: Mesh) -> Any:

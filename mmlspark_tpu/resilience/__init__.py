@@ -10,37 +10,52 @@ as first-class, individually testable pieces:
   retry.py        exponential backoff + full jitter, classification, budgets
   breaker.py      per-endpoint circuit breaker (closed/open/half-open)
   chaos.py        deterministic seeded fault injector (MMLSPARK_TPU_CHAOS_*)
+                  + the declarative multi-fault Scenario DSL and runner
   net.py          the single urlopen seam (lint-enforced) + fetch_url
-  checkpoints.py  keep-last-K rotation, LATEST pointer, checksum validation
+  checkpoints.py  keep-last-K rotation, LATEST pointer, checksum validation,
+                  orphan-tmp sweep, elastic-resume meta sidecar
+  ckpt_writer.py  async checkpoint writer thread (the ONE home of
+                  training-path checkpoint serialization; lint-enforced)
   preemption.py   SIGTERM -> finish step -> emergency checkpoint -> Preempted
+                  + the hung-step watchdog (bounded-wait step execution)
 
 See docs/resilience.md for the operator-facing knobs.
 """
 
 from mmlspark_tpu.resilience.breaker import (CircuitBreaker, CircuitOpenError,
                                              get_breaker, reset_breakers)
-from mmlspark_tpu.resilience.chaos import (ChaosInjector, InjectedNetworkError,
-                                           InjectedStallError, get_injector,
-                                           reset_chaos)
-from mmlspark_tpu.resilience.checkpoints import (latest_valid_checkpoint,
+from mmlspark_tpu.resilience.chaos import (ChaosInjector, Fault,
+                                           InjectedNetworkError,
+                                           InjectedStallError, Scenario,
+                                           get_injector, reset_chaos,
+                                           run_scenario, set_injector)
+from mmlspark_tpu.resilience.checkpoints import (checkpoint_meta,
+                                                 latest_valid_checkpoint,
                                                  list_checkpoints,
+                                                 sweep_orphan_tmps,
                                                  write_checkpoint)
+from mmlspark_tpu.resilience.ckpt_writer import (CheckpointWriteError,
+                                                 CheckpointWriter)
 from mmlspark_tpu.resilience.clock import (Clock, VirtualClock, get_clock,
                                            set_clock)
 from mmlspark_tpu.resilience.net import fetch_url, http_get
-from mmlspark_tpu.resilience.preemption import Preempted, PreemptionGuard
+from mmlspark_tpu.resilience.preemption import (HungStepError, Preempted,
+                                                PreemptionGuard, StepWatchdog)
 from mmlspark_tpu.resilience.retry import (RetryBudgetExceeded, RetryPolicy,
                                            default_classify, retry_call,
                                            retryable_status)
 
 __all__ = [
     "CircuitBreaker", "CircuitOpenError", "get_breaker", "reset_breakers",
-    "ChaosInjector", "InjectedNetworkError", "InjectedStallError",
-    "get_injector", "reset_chaos",
-    "latest_valid_checkpoint", "list_checkpoints", "write_checkpoint",
+    "ChaosInjector", "Fault", "InjectedNetworkError", "InjectedStallError",
+    "Scenario", "get_injector", "reset_chaos", "run_scenario",
+    "set_injector",
+    "checkpoint_meta", "latest_valid_checkpoint", "list_checkpoints",
+    "sweep_orphan_tmps", "write_checkpoint",
+    "CheckpointWriteError", "CheckpointWriter",
     "Clock", "VirtualClock", "get_clock", "set_clock",
     "fetch_url", "http_get",
-    "Preempted", "PreemptionGuard",
+    "HungStepError", "Preempted", "PreemptionGuard", "StepWatchdog",
     "RetryBudgetExceeded", "RetryPolicy", "default_classify", "retry_call",
     "retryable_status",
 ]

@@ -8,24 +8,40 @@ that the I/O and training layers consult at their hazard points:
     error or a (virtual-clock) stalled-read timeout;
   * `on_step(step)`     — per training step: may deliver one simulated
     SIGTERM preemption at a configured step;
+  * `maybe_hang(step)`  — inside the (watchdog-bounded) step execution:
+    may stall for CHAOS_HANG_S real seconds, the hung-device drill;
   * `tear_file(path)`   — truncates a file in place, simulating a torn
     checkpoint from a crash or partial upload;
   * `maybe_tear_checkpoint(path)` — probabilistic form of the same, hooked
-    into checkpoint rotation.
+    into checkpoint rotation (target payload, sha256 sidecar, or the
+    LATEST pointer);
+  * `after_checkpoint_write(path)` — scripted post-rotation tears that
+    must survive prune (scenario runner).
 
 Determinism: one `random.Random(seed)` drives every probabilistic
 decision, so a given seed + call sequence produces the SAME fault
 pattern on every run — chaos tests are exactly reproducible, never
 flaky-by-design.  Everything is off (zero rates, no seed needed) unless
 the MMLSPARK_TPU_CHAOS_* variables turn it on.
+
+**Scenario DSL**: `Scenario(name, faults=[Fault(...)], expect={...})`
+declares a multi-fault script (e.g. NaN at step 30 + SIGTERM at step 45
++ a torn checkpoint on the 2nd rotation) with expected-outcome
+assertions; `run_scenario(scenario, run_fn)` installs the script, runs
+the workload, and checks `expect` against the observation dict `run_fn`
+returns (`min_`/`max_` prefixes give bounds, anything else is an exact
+match).  `make chaos-drill` runs the built-in scenario suite end-to-end
+(scripts/chaos_drill.py).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import random
 import signal
-from typing import Optional
+import time
+from typing import Callable, Optional, Sequence
 
 from mmlspark_tpu import config
 from mmlspark_tpu.observe.logging import get_logger
@@ -62,6 +78,23 @@ CHAOS_NAN_AT_STEP = config.register(
     "chaos injector: poison one training step's loss mask with NaN when "
     "training reaches this global step (0 = off) — the numerics-probe / "
     "halt_on_nonfinite drill (observe/numerics.py)", ptype=int)
+CHAOS_HANG_AT_STEP = config.register(
+    "MMLSPARK_TPU_CHAOS_HANG_AT_STEP", 0,
+    "chaos injector: stall one training step for CHAOS_HANG_S real "
+    "seconds when training reaches this global step (0 = off) — the "
+    "hung-device drill the step watchdog exists for "
+    "(TrainerConfig.step_timeout_s)", ptype=int)
+CHAOS_HANG_S = config.register(
+    "MMLSPARK_TPU_CHAOS_HANG_S", 30.0,
+    "chaos injector: hung-step stall duration in REAL seconds (the "
+    "watchdog races a wall-clock deadline, so this one hazard cannot "
+    "ride the virtual clock)", ptype=float)
+CHAOS_TORN_CKPT_TARGET = config.register(
+    "MMLSPARK_TPU_CHAOS_TORN_CKPT_TARGET", "payload",
+    "chaos injector: what the torn-checkpoint fault corrupts — "
+    "'payload' (truncate the msgpack), 'sidecar' (truncate the sha256), "
+    "or 'latest' (truncate the LATEST pointer); restore must skip to a "
+    "valid checkpoint in every case", ptype=str)
 
 
 class InjectedNetworkError(ConnectionError):
@@ -70,6 +103,32 @@ class InjectedNetworkError(ConnectionError):
 
 class InjectedStallError(TimeoutError):
     """A chaos-injected stalled read that hit its timeout."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scripted fault in a chaos scenario.
+
+    kind: 'nan' | 'sigterm' | 'hang' (fire once when training reaches
+    `step`) or 'tear' (corrupt `target` on the `at_write`-th rotation).
+    """
+
+    kind: str
+    step: int = 0            # nan / sigterm / hang trigger step
+    seconds: float = 0.5     # hang duration (REAL seconds)
+    at_write: int = 1        # tear: which checkpoint write (1-based)
+    target: str = "payload"  # tear: payload | sidecar | latest
+
+    _KINDS = ("nan", "sigterm", "hang", "tear")
+    _TARGETS = ("payload", "sidecar", "latest")
+
+    def __post_init__(self):
+        if self.kind not in self._KINDS:
+            raise ValueError(f"fault kind must be one of {self._KINDS}, "
+                             f"got {self.kind!r}")
+        if self.kind == "tear" and self.target not in self._TARGETS:
+            raise ValueError(f"tear target must be one of {self._TARGETS}, "
+                             f"got {self.target!r}")
 
 
 class ChaosInjector:
@@ -81,24 +140,46 @@ class ChaosInjector:
                  stall_s: Optional[float] = None,
                  torn_ckpt_rate: Optional[float] = None,
                  preempt_at_step: Optional[int] = None,
-                 nan_at_step: Optional[int] = None):
+                 nan_at_step: Optional[int] = None,
+                 hang_at_step: Optional[int] = None,
+                 hang_s: Optional[float] = None,
+                 torn_ckpt_target: Optional[str] = None,
+                 script: Optional[Sequence[Fault]] = None):
         read = lambda explicit, var, cast: cast(
             var.current() if explicit is None else explicit)
         self.net_error_rate = read(net_error_rate, CHAOS_NET_ERROR_RATE, float)
         self.stall_rate = read(stall_rate, CHAOS_STALL_RATE, float)
         self.stall_s = read(stall_s, CHAOS_STALL_S, float)
         self.torn_ckpt_rate = read(torn_ckpt_rate, CHAOS_TORN_CKPT_RATE, float)
+        self.torn_ckpt_target = read(torn_ckpt_target,
+                                     CHAOS_TORN_CKPT_TARGET, str)
         self.preempt_at_step = read(preempt_at_step, CHAOS_PREEMPT_AT_STEP, int)
         self.nan_at_step = read(nan_at_step, CHAOS_NAN_AT_STEP, int)
+        self.hang_at_step = read(hang_at_step, CHAOS_HANG_AT_STEP, int)
+        self.hang_s = read(hang_s, CHAOS_HANG_S, float)
+        # the declarative multi-fault script (scenario runner): each entry
+        # fires at most once, latched by its index
+        self.script: list[Fault] = list(script or [])
+        self._fired: set = set()
+        self._write_count = 0
         self._rng = random.Random(read(seed, CHAOS_SEED, int))
         self._preempt_fired = False
         self._nan_fired = False
+        self._hang_fired = False
 
     @property
     def active(self) -> bool:
         return bool(self.net_error_rate or self.stall_rate
                     or self.torn_ckpt_rate or self.preempt_at_step
-                    or self.nan_at_step)
+                    or self.nan_at_step or self.hang_at_step or self.script)
+
+    def _script_due(self, kind: str, step: int) -> Optional[Fault]:
+        """The first unfired scripted fault of `kind` due at `step`."""
+        for i, f in enumerate(self.script):
+            if f.kind == kind and i not in self._fired and step >= f.step:
+                self._fired.add(i)
+                return f
+        return None
 
     # -- network hazards -------------------------------------------------
     def on_request(self, url: str) -> None:
@@ -127,11 +208,44 @@ class ChaosInjector:
         trace_event("chaos.torn_file", cat="resilience", path=path)
         get_logger("resilience").warning("chaos: tore file %s", path)
 
+    @classmethod
+    def tear_checkpoint(cls, path: str, target: str = "payload") -> None:
+        """Tear one aspect of a written checkpoint: the msgpack payload,
+        its sha256 sidecar, or the directory's LATEST pointer — the three
+        distinct corruption states a crash/partial upload can leave.
+        Restore must skip to a valid checkpoint under ALL of them."""
+        if target == "sidecar":
+            cls.tear_file(path + ".sha256")
+        elif target == "latest":
+            from mmlspark_tpu.resilience.checkpoints import LATEST
+            cls.tear_file(os.path.join(os.path.dirname(path), LATEST))
+        else:
+            cls.tear_file(path)
+
     def maybe_tear_checkpoint(self, path: str) -> bool:
         if self.torn_ckpt_rate and self._rng.random() < self.torn_ckpt_rate:
-            self.tear_file(path)
+            self.tear_checkpoint(path, self.torn_ckpt_target)
             return True
         return False
+
+    def after_checkpoint_write(self, path: str) -> bool:
+        """Post-rotation hook (runs AFTER the LATEST move and prune):
+        scripted scenario tears land here so the corrupt state survives
+        on disk for the next restore to prove it skips it."""
+        self._write_count += 1
+        fault = None
+        for i, f in enumerate(self.script):
+            if f.kind == "tear" and i not in self._fired \
+                    and self._write_count >= f.at_write:
+                self._fired.add(i)
+                fault = f
+                break
+        if fault is None:
+            return False
+        trace_event("chaos.torn_checkpoint", cat="resilience", path=path,
+                    target=fault.target, write=self._write_count)
+        self.tear_checkpoint(path, fault.target)
+        return True
 
     # -- preemption -------------------------------------------------------
     def on_step(self, step: int) -> None:
@@ -140,31 +254,59 @@ class ChaosInjector:
         Uses a real signal (not a flag) so the SAME handler path that a
         cloud preemption notice exercises is the one under test.
         """
-        if (self.preempt_at_step and not self._preempt_fired
-                and step >= self.preempt_at_step):
-            self._preempt_fired = True
+        due = self._script_due("sigterm", step) is not None
+        if due or (self.preempt_at_step and not self._preempt_fired
+                   and step >= self.preempt_at_step):
+            if not due:
+                self._preempt_fired = True
             inc_counter("chaos.preemptions")
             trace_event("chaos.preemption", cat="resilience", step=step)
             get_logger("resilience").warning(
                 "chaos: raising simulated SIGTERM at step %d", step)
             signal.raise_signal(signal.SIGTERM)
 
+    def maybe_hang(self, step: int) -> bool:
+        """Stall the calling thread for `hang_s` REAL seconds, once, when
+        `step` reaches the configured hang point — the hung-device drill.
+        Called INSIDE the step execution the watchdog bounds
+        (train/trainer.py), so the stall is observed exactly where a
+        wedged collective or device would be."""
+        fault = self._script_due("hang", step)
+        hang_s = fault.seconds if fault is not None else self.hang_s
+        due = fault is not None
+        if not due and self.hang_at_step and not self._hang_fired \
+                and step >= self.hang_at_step:
+            self._hang_fired = True
+            due = True
+        if not due:
+            return False
+        inc_counter("chaos.hangs")
+        trace_event("chaos.hang", cat="resilience", step=step,
+                    hang_s=hang_s)
+        get_logger("resilience").warning(
+            "chaos: hanging step %d for %.2fs (real time)", step, hang_s)
+        time.sleep(hang_s)  # REAL seconds: the watchdog deadline is wall
+        return True
+
     # -- numerics hazards --------------------------------------------------
     def poison_nan(self, step: int) -> bool:
-        """True exactly once, when `step` reaches the configured NaN
-        injection point; the trainer then multiplies the step's loss mask
-        by NaN (dtype-agnostic — poisons float and token models alike),
-        so loss, gradients, and the updated params all go non-finite —
-        the drill the numerics probe and halt_on_nonfinite exist for."""
-        if (self.nan_at_step and not self._nan_fired
-                and step >= self.nan_at_step):
+        """True exactly once (per configured injection), when `step`
+        reaches a NaN injection point; the trainer then multiplies the
+        step's loss mask by NaN (dtype-agnostic — poisons float and token
+        models alike), so loss, gradients, and the updated params all go
+        non-finite — the drill the numerics probe, halt_on_nonfinite, and
+        the recovery supervisor exist for."""
+        due = self._script_due("nan", step) is not None
+        if not due and self.nan_at_step and not self._nan_fired \
+                and step >= self.nan_at_step:
             self._nan_fired = True
+            due = True
+        if due:
             inc_counter("chaos.nan_injections")
             trace_event("chaos.nan_injection", cat="resilience", step=step)
             get_logger("resilience").warning(
                 "chaos: poisoning step %d loss mask with NaN", step)
-            return True
-        return False
+        return due
 
 
 _injector: Optional[ChaosInjector] = None
@@ -178,8 +320,75 @@ def get_injector() -> ChaosInjector:
     return _injector
 
 
+def set_injector(injector: Optional[ChaosInjector]) -> Optional[ChaosInjector]:
+    """Install a specific injector (the scenario runner's seam); returns
+    the previous one so callers can restore it.  None = rebuild lazily
+    from config on next use."""
+    global _injector
+    previous, _injector = _injector, injector
+    return previous
+
+
 def reset_chaos() -> None:
     """Rebuild the injector from current config on next use (tests call
     this after flipping CHAOS_* variables)."""
     global _injector
     _injector = None
+
+
+# --------------------------------------------------------------------------
+# Declarative chaos scenarios: multi-fault scripts + expected outcomes
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Scenario:
+    """One declarative chaos drill: a fault script plus the outcome it
+    must produce.
+
+    `expect` keys check the observation dict the workload returns:
+    `min_<k>`/`max_<k>` bound `obs[k]`; any other key is an exact match.
+    Typical observation keys (see scripts/chaos_drill.py and
+    tests/test_recovery.py): outcome ('completed' | 'gave_up' |
+    'preempted'), steps, recoveries, finite (bool).
+    """
+
+    name: str
+    faults: Sequence[Fault] = dataclasses.field(default_factory=list)
+    expect: dict = dataclasses.field(default_factory=dict)
+
+
+def run_scenario(scenario: Scenario, run_fn: Callable[[], dict]) -> dict:
+    """Install the scenario's fault script, run the workload, check the
+    expectations.
+
+    `run_fn` owns the workload (typically a RecoverySupervisor fit) and
+    returns an observation dict; this runner never raises on a failed
+    expectation — it returns a machine-readable report
+    `{name, passed, checks: {key: {want, got, ok}}, observed}` so a
+    drill suite can run every scenario and fail at the end with the full
+    picture.  The previous process injector is restored on exit.
+    """
+    previous = set_injector(ChaosInjector(script=list(scenario.faults)))
+    trace_event("chaos.scenario_start", cat="resilience",
+                scenario=scenario.name, faults=len(list(scenario.faults)))
+    try:
+        observed = run_fn()
+    finally:
+        set_injector(previous)
+    checks: dict = {}
+    for key, want in scenario.expect.items():
+        if key.startswith("min_"):
+            got = observed.get(key[4:])
+            ok = got is not None and got >= want
+        elif key.startswith("max_"):
+            got = observed.get(key[4:])
+            ok = got is not None and got <= want
+        else:
+            got = observed.get(key)
+            ok = got == want
+        checks[key] = {"want": want, "got": got, "ok": bool(ok)}
+    passed = all(c["ok"] for c in checks.values())
+    trace_event("chaos.scenario_end", cat="resilience",
+                scenario=scenario.name, passed=passed)
+    return {"name": scenario.name, "passed": passed, "checks": checks,
+            "observed": observed}

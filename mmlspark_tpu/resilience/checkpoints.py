@@ -24,6 +24,7 @@ compatibility with pre-rotation directories.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 from typing import Optional
 
@@ -62,30 +63,90 @@ def _atomic_write(path: str, data: bytes) -> None:
     os.replace(tmp, path)  # atomic: a crash never leaves a torn file HERE
 
 
+def sweep_orphan_tmps(ckpt_dir: str) -> int:
+    """Delete stray `*.tmp` files from a writer killed mid-write.
+
+    The atomic tmp+rename protocol means a crash can only ever leave
+    `.tmp` orphans — ignorable but previously immortal, so a directory
+    that survived several preemptions slowly accreted junk.  Swept on
+    every rotation open (write and restore walk); safe because the
+    rotation layout has exactly one writer (the coordinator's writer
+    thread) and sweeps never run concurrently with its renames.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return 0
+    swept = 0
+    for name in os.listdir(ckpt_dir):
+        if not name.endswith(".tmp"):
+            continue
+        try:
+            os.remove(os.path.join(ckpt_dir, name))
+            swept += 1
+        except FileNotFoundError:
+            continue
+    if swept:
+        inc_counter("checkpoint.orphan_tmps_swept", swept)
+        trace_event("checkpoint.orphan_tmps_swept", cat="resilience",
+                    dir=ckpt_dir, count=swept)
+        get_logger("resilience").warning(
+            "swept %d orphaned .tmp file(s) from %s (writer killed "
+            "mid-write)", swept, ckpt_dir)
+    return swept
+
+
 def write_checkpoint(ckpt_dir: str, step: int, data: bytes,
-                     keep: Optional[int] = None) -> str:
+                     keep: Optional[int] = None,
+                     meta: Optional[dict] = None) -> str:
     """Write one checkpoint + checksum sidecar, advance LATEST, prune.
 
     Returns the payload path.  The sidecar is written BEFORE the payload
     rename lands and LATEST moves only after both, so every state a crash
     can leave behind is either ignorable (orphan tmp/sidecar) or valid.
+    `meta` (topology/batch info for elastic resume) lands in a
+    `.meta.json` sidecar — advisory, not checksummed: restore treats a
+    missing or unreadable meta as "no adjustment", never as corruption.
     """
     with trace_span("checkpoint.write", cat="checkpoint", step=step,
                     bytes=len(data)):
         os.makedirs(ckpt_dir, exist_ok=True)
+        sweep_orphan_tmps(ckpt_dir)
         name = checkpoint_name(step)
         path = os.path.join(ckpt_dir, name)
         _atomic_write(path + ".sha256", _sha256(data).encode())
+        if meta is not None:
+            _atomic_write(path + ".meta.json",
+                          json.dumps(meta, sort_keys=True).encode())
         _atomic_write(path, data)
-        # chaos may tear the file we just wrote (simulating partial upload
-        # / crash-adjacent corruption); restore-side validation absorbs it
+        _atomic_write(os.path.join(ckpt_dir, LATEST), name.encode())
+        # chaos may tear what we just wrote — payload, sidecar, or the
+        # LATEST pointer (simulating partial upload / crash-adjacent
+        # corruption); restore-side validation absorbs all three
         from mmlspark_tpu.resilience.chaos import get_injector
         get_injector().maybe_tear_checkpoint(path)
-        _atomic_write(os.path.join(ckpt_dir, LATEST), name.encode())
         inc_counter("checkpoint.writes")
         prune(ckpt_dir,
               keep if keep is not None else int(CKPT_KEEP.current()))
+        # post-rotation chaos hook: scripted scenario tears (payload /
+        # sidecar / LATEST pointer) land AFTER prune, so the torn state
+        # stays on disk for the next restore to prove it skips it
+        get_injector().after_checkpoint_write(path)
         return path
+
+
+def checkpoint_meta(path: Optional[str]) -> Optional[dict]:
+    """The `.meta.json` sidecar of a checkpoint payload path, or None.
+
+    Advisory by design: any read/parse failure returns None (the restore
+    then proceeds without elastic adjustment) — meta corruption must
+    never make an otherwise-valid checkpoint unrestorable."""
+    if not path:
+        return None
+    try:
+        with open(path + ".meta.json") as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None
 
 
 def list_checkpoints(ckpt_dir: str) -> list[tuple[int, str]]:
@@ -121,6 +182,7 @@ def latest_valid_checkpoint(ckpt_dir: str) -> Optional[str]:
     Invalid candidates are skipped with a warning, not raised on.
     """
     with trace_span("checkpoint.validate", cat="checkpoint"):
+        sweep_orphan_tmps(ckpt_dir)
         candidates: list[str] = []
         pointer = os.path.join(ckpt_dir, LATEST)
         if os.path.exists(pointer):
@@ -159,7 +221,7 @@ def prune(ckpt_dir: str, keep: int) -> None:
         if kept < keep and is_valid(path):
             kept += 1
             continue
-        for victim in (path, path + ".sha256"):
+        for victim in (path, path + ".sha256", path + ".meta.json"):
             try:
                 os.remove(victim)
             except FileNotFoundError:

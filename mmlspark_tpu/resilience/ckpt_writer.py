@@ -1,0 +1,202 @@
+"""Async checkpoint writer: move save cost off the training step loop.
+
+A synchronous rotation save costs the step loop the full
+device→host fetch + flax serialization + sha256 + disk write every
+`checkpoint_every_steps` — on big states that is tens of milliseconds of
+pure host work the MXU spends idle (the TensorFlow system paper credits
+background-thread user-level checkpointing for making multi-week runs
+viable; this is that design).  The split here:
+
+  * **step loop (caller)** — runs the gather collective (device-side,
+    async dispatch, must stay on the main thread in lockstep under
+    multi-host) and hands the resulting *device* tree to `submit()`.
+    No D2H copy, no serialization, no disk I/O on the loop.
+  * **writer thread** — `jax.device_get` (blocks HERE on the step's
+    completion + D2H), `flax.serialization.to_bytes`, then
+    `checkpoints.write_checkpoint` (atomic tmp/rename + sidecar + LATEST
+    + prune).  The gather's output arrays are fresh jit outputs, so the
+    step loop donating its state buffers never invalidates a pending
+    write.
+
+At most ONE write is in flight: a `submit()` racing a slow disk blocks
+(backpressure — bounded memory, and rotation order stays submission
+order).  `drain()` is the shutdown/preemption barrier: emergency and
+final saves call it so the checkpoint is durable before the process
+exits.  A writer-thread failure is latched and re-raised (as
+`CheckpointWriteError`) from the next `submit()`/`drain()` — async never
+silently drops a checkpoint.
+
+This module is the ONE place training-path checkpoint serialization is
+allowed to live: `scripts/lint.py` forbids `to_bytes`/`from_bytes`/
+`write_checkpoint` calls inside `mmlspark_tpu/train/`, so a synchronous
+save can never quietly creep back into the step loop.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+from flax import serialization
+
+from mmlspark_tpu.observe.logging import get_logger
+from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.observe.trace import (active_tracer, trace_event,
+                                        trace_span, tracing)
+from mmlspark_tpu.resilience.checkpoints import (checkpoint_name,
+                                                 write_checkpoint)
+
+
+class CheckpointWriteError(RuntimeError):
+    """A background checkpoint write failed; the original error is
+    chained as __cause__.  Raised from the submit/drain AFTER the
+    failure, so the step loop finds out at the next checkpoint boundary
+    instead of never."""
+
+
+def serialize_tree(host_tree: Any) -> bytes:
+    """Host pytree -> msgpack bytes (the rotation payload format)."""
+    return serialization.to_bytes(host_tree)
+
+
+def read_checkpoint(template: Any, path: str) -> Any:
+    """Load a rotation payload into `template`'s structure/shapes/dtypes
+    (the restore-side counterpart; host arrays only, no device state)."""
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())
+
+
+class CheckpointWriter:
+    """One background writer for one checkpoint directory.
+
+    `submit(step, dev_tree, meta)` hands a (gathered, device-resident)
+    state tree to the writer thread; `drain()` blocks until every
+    submitted write is durable; `close()` drains and stops the thread.
+    `sync=True` on submit is the one-call synchronous form
+    (submit + drain) used for emergency/final saves.
+    """
+
+    def __init__(self, ckpt_dir: str, keep: Optional[int] = None,
+                 name: str = "ckpt-writer"):
+        self.ckpt_dir = ckpt_dir
+        self.keep = keep
+        self._name = name
+        self._cond = threading.Condition()
+        self._item: Optional[tuple] = None   # (step, dev_tree, meta)
+        self._inflight = 0                   # submitted, not yet durable
+        self._error: Optional[BaseException] = None
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- caller side -----------------------------------------------------
+    def submit(self, step: int, dev_tree: Any, meta: Optional[dict] = None,
+               sync: bool = False) -> str:
+        """Queue one write; blocks only while a PREVIOUS write is still in
+        flight (single-slot backpressure).  Returns the payload path the
+        write will land at."""
+        self._raise_pending()
+        # the run's tracer is captured HERE, on the caller thread — the
+        # writer thread never inherits contextvars (the same
+        # capture-by-closure rule as the prefetch workers), and without
+        # it the checkpoint.write spans would vanish from the run record
+        tracer = active_tracer()
+        with self._cond:
+            while self._item is not None and self._error is None:
+                self._cond.wait()
+            self._raise_pending_locked()
+            self._item = (int(step), dev_tree, meta, tracer)
+            self._inflight += 1
+            self._ensure_thread()
+            self._cond.notify_all()
+        if sync:
+            self.drain()
+        return os.path.join(self.ckpt_dir, checkpoint_name(int(step)))
+
+    def drain(self) -> None:
+        """Block until every submitted write is on disk (the shutdown /
+        preemption barrier); surfaces any latched writer failure."""
+        with self._cond:
+            while self._inflight > 0 and self._error is None:
+                self._cond.wait()
+        self._raise_pending()
+
+    def close(self, best_effort: bool = False) -> None:
+        """Drain and stop the writer thread.  `best_effort=True` logs a
+        latched failure instead of raising (finally-block form: never
+        mask the exception already unwinding)."""
+        try:
+            self.drain()
+        except CheckpointWriteError as e:
+            if not best_effort:
+                raise
+            get_logger("resilience").warning(
+                "checkpoint writer for %s closed with a failed write: %s",
+                self.ckpt_dir, e.__cause__)
+        finally:
+            with self._cond:
+                self._stop = True
+                self._cond.notify_all()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
+
+    # -- error surfacing -------------------------------------------------
+    def _raise_pending(self) -> None:
+        with self._cond:
+            self._raise_pending_locked()
+
+    def _raise_pending_locked(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise CheckpointWriteError(
+                f"background checkpoint write to {self.ckpt_dir} "
+                f"failed") from err
+
+    # -- writer thread ---------------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"mmlspark-{self._name}")
+            self._thread.start()
+
+    def _run(self) -> None:
+        import contextlib
+
+        import jax
+        while True:
+            with self._cond:
+                while self._item is None and not self._stop:
+                    self._cond.wait()
+                if self._item is None and self._stop:
+                    return
+                step, dev_tree, meta, tracer = self._item
+            try:
+                # install the submitting run's tracer for this write so
+                # checkpoint.write spans + chaos tear events land in it
+                scope = tracing(tracer) if tracer is not None \
+                    else contextlib.nullcontext()
+                with scope, trace_span("checkpoint.async_write",
+                                       cat="checkpoint", step=step):
+                    # blocks HERE (writer thread) on step completion + D2H
+                    host = jax.device_get(dev_tree)
+                    write_checkpoint(self.ckpt_dir, step,
+                                     serialize_tree(host),
+                                     keep=self.keep, meta=meta)
+                inc_counter("checkpoint.async_writes")
+            except BaseException as e:  # latched; surfaced at next submit/drain
+                with self._cond:
+                    self._error = e
+                inc_counter("checkpoint.async_write_failures")
+                trace_event("checkpoint.async_write_failed",
+                            cat="resilience", step=step, error=repr(e))
+                get_logger("resilience").error(
+                    "async checkpoint write (step %d, %s) failed: %s",
+                    step, self.ckpt_dir, e)
+            finally:
+                with self._cond:
+                    self._item = None
+                    self._inflight -= 1
+                    self._cond.notify_all()

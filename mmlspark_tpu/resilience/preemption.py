@@ -14,13 +14,23 @@ guard restores it on exit (context manager), so the framework never
 swallows the application's own shutdown hooks.  Installation is skipped
 off the main thread (signal.signal would raise) — there the flag can
 still be set by `request()` (e.g. a cluster-notice poller).
+
+`StepWatchdog` is the second half of the hang story: SIGTERM covers the
+*planned* death, the watchdog covers the silent one — a wedged device, a
+deadlocked collective a peer never joined, a driver stall.  It runs one
+training step with a bounded wall-clock wait (the same worker-thread
+pattern as `parallel/distributed.run_collective`); past the deadline it
+raises `HungStepError` so the trainer can write an emergency checkpoint
+of the last completed state and abort cleanly — and the recovery
+supervisor (train/supervisor.py) can restart-and-resume — instead of
+the job wedging forever inside an opaque device wait.
 """
 
 from __future__ import annotations
 
 import signal
 import threading
-from typing import Optional
+from typing import Any, Callable, Optional
 
 from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.observe.metrics import inc_counter
@@ -37,6 +47,70 @@ class Preempted(Exception):
             f"{ckpt_dir!r} — restart with resume=True to continue")
         self.step = step
         self.ckpt_dir = ckpt_dir
+
+
+class HungStepError(RuntimeError):
+    """A training step did not complete within the watchdog deadline —
+    the device/step is stalled (wedged collective, dead peer, driver
+    hang).  The trainer writes a best-effort emergency checkpoint before
+    letting this escape; a supervisor restarts and resumes."""
+
+    def __init__(self, step: int, deadline_s: float,
+                 ckpt_dir: Optional[str] = None):
+        self.step = step
+        self.deadline_s = deadline_s
+        self.ckpt_dir = ckpt_dir
+        msg = (f"training step {step} stalled past the {deadline_s:.1f}s "
+               f"watchdog deadline — device/collective likely wedged")
+        if ckpt_dir:
+            msg += (f"; restart with resume=True against {ckpt_dir} "
+                    f"(or run under RecoverySupervisor) to continue")
+        super().__init__(msg)
+
+
+class StepWatchdog:
+    """Bounded-wait execution of one training step.
+
+    `run(fn, step)` executes `fn` on a worker thread and waits at most
+    `deadline_s` wall seconds; on expiry it raises `HungStepError` and
+    abandons the (daemonic) worker — for a real device hang the process
+    is expected to abort and resume from checkpoint, exactly like
+    `run_collective`'s timeout contract.  The callable must therefore
+    *synchronize* on the step's results (block_until_ready) so an
+    async-dispatched-but-never-finishing step counts as hung."""
+
+    def __init__(self, deadline_s: float):
+        if deadline_s <= 0:
+            raise ValueError(
+                f"watchdog deadline must be positive, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+
+    def run(self, fn: Callable[[], Any], step: int,
+            ckpt_dir: Optional[str] = None) -> Any:
+        result: dict = {}
+        error: list = []
+
+        def work():
+            try:
+                result["value"] = fn()
+            except BaseException as e:  # surfaced to the caller below
+                error.append(e)
+
+        worker = threading.Thread(target=work, daemon=True,
+                                  name=f"step-watchdog-{step}")
+        worker.start()
+        worker.join(self.deadline_s)
+        if worker.is_alive():
+            inc_counter("watchdog.hung_steps")
+            trace_event("watchdog.hung_step", cat="resilience", step=step,
+                        deadline_s=self.deadline_s)
+            get_logger("resilience").error(
+                "watchdog: step %d stalled past %.1fs deadline", step,
+                self.deadline_s)
+            raise HungStepError(step, self.deadline_s, ckpt_dir)
+        if error:
+            raise error[0]
+        return result["value"]
 
 
 class PreemptionGuard:

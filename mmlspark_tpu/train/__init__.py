@@ -1,3 +1,6 @@
 from mmlspark_tpu.train.config import TrainerConfig
 from mmlspark_tpu.train.trainer import Trainer, TrainState
 from mmlspark_tpu.train.learner import TPULearner
+from mmlspark_tpu.train.supervisor import (RecoveryBudgetExceeded,
+                                           RecoveryPolicy,
+                                           RecoverySupervisor)

@@ -63,6 +63,25 @@ class TrainerConfig:
     # checkpoint/resume (the reference had none, SURVEY section 5)
     checkpoint_dir: Optional[str] = None
     checkpoint_every_steps: int = 0        # 0 = only at end
+    # background checkpoint writes (resilience/ckpt_writer.py): the step
+    # loop only runs the device-side gather and hands the arrays off; the
+    # D2H fetch + serialization + atomic write + rotation happen on the
+    # writer thread, so checkpoint cadence stops costing step time.
+    # Emergency (preemption/hang) and final saves always drain the writer
+    # before returning.  False = every save drains immediately (the old
+    # synchronous timing, same on-disk result).
+    async_checkpointing: bool = True
+    # hung-step watchdog (resilience/preemption.py StepWatchdog): bound
+    # each step's wall time; past the deadline HungStepError is raised
+    # after a best-effort emergency checkpoint, and a supervisor resumes
+    # from the newest valid one.  Costs one worker-thread hop and a
+    # block_until_ready per step while armed.  0 = off.
+    step_timeout_s: float = 0.0
+    # recovery: fold an attempt number into the data-order RNG so a
+    # supervisor retry shuffles DIFFERENT batches after the restore point
+    # (a data-dependent poison is not replayed step-for-step).  0 keeps
+    # the historical stream byte-identical.
+    rng_fold: int = 0
 
     # numerics health (observe/numerics.py): every `numerics_cadence`
     # steps the jitted probe reports non-finite counts and per-layer-group
@@ -73,6 +92,10 @@ class TrainerConfig:
     # never rotates over the last finite checkpoint.
     numerics_cadence: int = 50
     halt_on_nonfinite: bool = False
+    # like halt_on_nonfinite, for the loss-spike detector's `divergence`
+    # verdict: raise DivergenceError at the step boundary BEFORE any
+    # checkpoint write, so the newest checkpoint stays pre-divergence
+    halt_on_divergence: bool = False
 
     # weight on model-sown auxiliary losses (flax "losses" collection,
     # e.g. the MoE load-balance term); 0 ignores the sown values

@@ -24,13 +24,13 @@ from __future__ import annotations
 
 import inspect
 import os
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from flax import serialization, struct
+from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mmlspark_tpu.models.bundle import ModelBundle, _to_plain
@@ -38,24 +38,28 @@ from mmlspark_tpu.models.definitions import build_model
 from mmlspark_tpu.observe import MetricData, get_logger
 from mmlspark_tpu.observe.costmodel import capture_program_cost
 from mmlspark_tpu.observe.metrics import inc_counter
-from mmlspark_tpu.observe.numerics import (LossSpikeDetector, NonFiniteError,
-                                           tree_health)
+from mmlspark_tpu.observe.numerics import (DivergenceError, LossSpikeDetector,
+                                           NonFiniteError, tree_health)
 from mmlspark_tpu.observe.spans import active_timings, monotonic, span_on
 from mmlspark_tpu.observe.telemetry import active_run
 from mmlspark_tpu.observe.trace import (active_tracer, current_span_id,
                                         span_on_tracer, trace_event,
                                         trace_span)
 from mmlspark_tpu.parallel.bridge import (gather_replicated, gather_to_host,
-                                          put_like, put_sharded, put_tree)
+                                          put_sharded, put_tree,
+                                          put_tree_like, snapshot_tree)
 from mmlspark_tpu.parallel.distributed import (barrier, initialize_distributed,
                                                is_coordinator, run_collective)
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh, replicated
 from mmlspark_tpu.parallel.prefetch import Prefetcher
 from mmlspark_tpu.resilience.chaos import get_injector
-from mmlspark_tpu.resilience.checkpoints import (checkpoint_name,
-                                                 latest_valid_checkpoint,
-                                                 write_checkpoint)
-from mmlspark_tpu.resilience.preemption import Preempted, PreemptionGuard
+from mmlspark_tpu.resilience.checkpoints import (checkpoint_meta,
+                                                 checkpoint_name,
+                                                 latest_valid_checkpoint)
+from mmlspark_tpu.resilience.ckpt_writer import (CheckpointWriter,
+                                                 read_checkpoint)
+from mmlspark_tpu.resilience.preemption import (HungStepError, Preempted,
+                                                PreemptionGuard, StepWatchdog)
 from mmlspark_tpu.train.config import TrainerConfig
 
 
@@ -163,6 +167,10 @@ class Trainer:
         self._loss = _make_loss(config.loss)
         self.history: list[dict] = []
         self._pp = config.pipeline_stages > 1
+        # background checkpoint writers, one per directory (resilience/
+        # ckpt_writer.py); created lazily, closed at the end of each fit
+        self._writers: dict[str, CheckpointWriter] = {}
+        self._effective_batch_size: Optional[int] = None
         if self._pp:
             self._validate_pipeline()
 
@@ -394,7 +402,9 @@ class Trainer:
                    log_every: int = 50,
                    log_fn: Optional[Callable[[str], None]] = None,
                    ckpt_dir: Optional[str] = None,
-                   resume: bool = False) -> ModelBundle:
+                   resume: bool = False,
+                   skip_data_windows: Optional[Sequence] = None
+                   ) -> ModelBundle:
         """Train on arrays; under multi-host, `x`/`y` are this process's
         local data partition (the per-node data shard of the reference's
         MPI topology, CommandBuilders.scala:95-117) and each process
@@ -410,6 +420,20 @@ class Trainer:
         the same data order and skipping already-completed steps, so a
         preempted-and-resumed run finishes with the same step count as an
         uninterrupted one.
+
+        Elastic resume: the checkpoint's `.meta.json` records the
+        topology and EFFECTIVE batch size it was written under; a resume
+        onto a different device count adopts the saved batch size (when
+        it still divides the new data axis) so step numbering and data
+        order replay identically, and restore re-commits the gathered
+        full-shape arrays onto the new mesh's shardings (put_tree_like).
+
+        `skip_data_windows` ([(first_step, last_step)] inclusive global
+        executed-step ranges, normally supplied by the recovery
+        supervisor) skips those steps' optimizer updates AND their data:
+        the step counter advances (total step numbering is preserved —
+        the loss-scaling "skip step" convention) but the offending
+        window's batches are never staged or fed.
         """
         cfg = self.config
         ckpt_dir = ckpt_dir if ckpt_dir is not None else cfg.checkpoint_dir
@@ -456,6 +480,42 @@ class Trainer:
             # microbatches for the GPipe schedule
             unit = data_size * cfg.pipeline_microbatches
             bs = max(bs - bs % unit, unit)
+        # elastic resume: a checkpoint written under a different device
+        # count may have clamped a different effective batch size; adopt
+        # the SAVED one (when it still divides the new data axis) so the
+        # resumed run replays the identical step numbering and data order
+        # the original fed.  Meta is read on the coordinator only — the
+        # single-writer of the directory — and is advisory (missing meta
+        # = no adjustment, pre-meta checkpoints keep restoring).
+        if resume and ckpt_dir and is_coordinator():
+            saved = checkpoint_meta(latest_valid_checkpoint(ckpt_dir)) or {}
+            saved_bs = int(saved.get("effective_batch_size") or 0)
+            saved_dp = int(saved.get("data_devices") or 0)
+            if saved_dp and saved_dp != data_size:
+                trace_event("train.elastic_resume", cat="resilience",
+                            saved_dp=saved_dp, dp=data_size,
+                            saved_batch=saved_bs or bs, batch=bs)
+                inc_counter("train.elastic_resumes")
+                get_logger("train").info(
+                    "elastic resume: checkpoint written under dp=%d, "
+                    "restoring onto dp=%d (reshard-on-restore)",
+                    saved_dp, data_size)
+            if saved_bs and saved_bs != bs:
+                unit = data_size * (cfg.pipeline_microbatches
+                                    if self._pp else 1)
+                if saved_bs % unit:
+                    raise ValueError(
+                        f"elastic resume: checkpoint's effective batch "
+                        f"size {saved_bs} does not divide into the new "
+                        f"mesh's unit {unit} (data axis {data_size}); "
+                        f"pick a batch_size divisible by both device "
+                        f"counts to keep resumed runs reproducible")
+                get_logger("train").info(
+                    "elastic resume: adopting the checkpoint's effective "
+                    "batch size %d (config clamped to %d) so data order "
+                    "replays identically", saved_bs, bs)
+                bs = saved_bs
+        self._effective_batch_size = bs
         # rows this process feeds per global step; data_size % nproc == 0
         # and bs % data_size == 0 guarantee equal whole-row shares >= 1
         bs_local = bs // nproc
@@ -492,8 +552,13 @@ class Trainer:
         step_fn = self.make_train_step()
         x_sh = batch_sharding(self.mesh)
 
-        # distinct per-process streams so partitions shuffle independently
-        rng = np.random.default_rng(cfg.seed + jax.process_index())
+        # distinct per-process streams so partitions shuffle independently;
+        # a nonzero rng_fold (recovery retries) folds the attempt number in
+        # so the retry shuffles DIFFERENT batches past the restore point —
+        # fold 0 keeps the historical stream byte-identical
+        seed_key = cfg.seed + jax.process_index()
+        rng = np.random.default_rng(
+            seed_key if not cfg.rng_fold else [seed_key, int(cfg.rng_fold)])
         t0 = monotonic()
         # host-side counter seeded once from this run's base step so
         # checkpoint_every_steps boundaries stay aligned across fit()
@@ -535,6 +600,17 @@ class Trainer:
         detector = LossSpikeDetector() if cadence else None
         self.last_health: Optional[dict] = None
         prog_key: Optional[str] = None
+        # recovery skip windows (inclusive executed-step ranges): those
+        # steps advance the counter but stage no data and run no update —
+        # the supervisor's "skip the offending data window" lever
+        windows = [(int(a), int(b)) for a, b in (skip_data_windows or [])]
+        # hung-step watchdog: bounded-wait step execution (HungStepError
+        # past the deadline; resilience/preemption.py)
+        watchdog = StepWatchdog(cfg.step_timeout_s) \
+            if cfg.step_timeout_s and not self._pp else None
+
+        def _skipped(step_c: int) -> bool:
+            return any(a <= step_c <= b for a, b in windows)
 
         def plan():
             step_c = base_step
@@ -546,11 +622,20 @@ class Trainer:
                     if step_c < skip_until:  # completed before preemption
                         step_c += 1
                         continue
+                    if _skipped(step_c):
+                        # a recovery skip window: the marker (order=None)
+                        # advances the step counter downstream, and the
+                        # window's rows are never staged or transferred
+                        yield (epoch, step_c, None, start)
+                        step_c += 1
+                        continue
                     yield (epoch, step_c, order, start)
                     step_c += 1
 
         def stage(item):
             epoch, step_c, order, start = item
+            if order is None:  # skip-window marker: nothing to stage
+                return epoch, step_c, None, None, None
             with span_on_tracer(tracer, "train.stage", parent=fit_id,
                                 cat="stage", step=step_c):
                 with span_on(timings, "host"):
@@ -600,6 +685,7 @@ class Trainer:
 
         staged = Prefetcher(stage, plan(), depth=depth, name="train")
         first_exec = True  # the first executed step pays the jit compile
+        exec_count = 0     # watchdog warmup: see `dog` below
         with PreemptionGuard(install=bool(ckpt_dir)) as guard:
             try:
                 for epoch, step_c, xb, yb, mask_d in staged:
@@ -607,6 +693,17 @@ class Trainer:
                         finish_epoch()
                         cur_epoch = epoch
                         losses, step_metrics = [], []
+                    if xb is None:
+                        # recovery skip window: the optimizer update and
+                        # the window's data are skipped, but the step
+                        # counter advances so total step numbering (and
+                        # checkpoint naming) is preserved — the classic
+                        # loss-scaling "skip step" convention
+                        state = state.replace(step=state.step + 1)
+                        inc_counter("train.skipped_steps")
+                        trace_event("train.step_skipped", cat="resilience",
+                                    step=step_c, epoch=epoch)
+                        continue
                     chaos.on_step(step_c)  # may deliver simulated SIGTERM
                     if chaos.poison_nan(step_c):
                         # dtype-agnostic poison: a NaN loss mask drives
@@ -627,9 +724,33 @@ class Trainer:
                         capture_program_cost(step_fn, step_args,
                                              where="trainer",
                                              program=prog_key, run=run)
+
+                    # watchdog warmup: the first execution pays the jit
+                    # compile and the second may recompile at the
+                    # donation/layout fixed point (the output state's
+                    # layouts differ from eager init's) — both are
+                    # legitimately slow, minutes on big models, so the
+                    # step deadline arms from the third execution on
+                    # (an early wedge is bounded by the collective
+                    # timeouts instead)
+                    dog = watchdog if exec_count >= 2 else None
+
+                    def exec_step(args=step_args, step=step_c):
+                        chaos.maybe_hang(step)  # hung-device drill hazard
+                        out = step_fn(*args)
+                        if dog is not None:
+                            # the watchdog bounds a SYNCED execution: an
+                            # async dispatch that never finishes must
+                            # count as hung, not slip past the deadline
+                            jax.block_until_ready(out)
+                        return out
+
+                    run_step = exec_step if dog is None else (
+                        lambda: dog.run(exec_step, step=step_c,
+                                        ckpt_dir=ckpt_dir))
                     if tracer is None:
                         with span_on(timings, "compute"):
-                            state, loss, metrics = step_fn(*step_args)
+                            state, loss, metrics = run_step()
                     else:
                         # per-step span: the scalar fetches force the step
                         # to FINISH inside the span, so its wall is the
@@ -640,7 +761,7 @@ class Trainer:
                                 step=step_c, epoch=epoch,
                                 first_step_compile=first_exec) as sp, \
                                 span_on(timings, "compute"):
-                            state, loss, metrics = step_fn(*step_args)
+                            state, loss, metrics = run_step()
                             sp.attrs["loss"] = float(jax.device_get(loss))
                             if "grad_norm" in metrics:
                                 sp.attrs["grad_norm"] = float(
@@ -655,6 +776,7 @@ class Trainer:
                             run.add_program_time("trainer", prog_key, dur,
                                                  basis="step_wall")
                     first_exec = False
+                    exec_count += 1
                     health = metrics.pop("health", None) if cadence else None
                     losses.append(loss)  # device array; fetched at epoch end
                     if metrics:
@@ -668,7 +790,11 @@ class Trainer:
                     step = step_c + 1
                     if ckpt_dir and cfg.checkpoint_every_steps and \
                             step % cfg.checkpoint_every_steps == 0:
-                        self.save_checkpoint(state, ckpt_dir)
+                        # async by default: the gather stays on this
+                        # thread (collective), serialization + disk move
+                        # to the writer thread (resilience/ckpt_writer.py)
+                        self.save_checkpoint(state, ckpt_dir, step=step,
+                                             sync=not cfg.async_checkpointing)
                     # the in-flight step finished; honor a pending SIGTERM
                     # at the step boundary (lockstep under multi-host:
                     # every process must agree before the collective save).
@@ -683,18 +809,43 @@ class Trainer:
                                     np.asarray(int(guard.triggered))))
                                 .max())))
                     if preempt_now:
-                        self.save_checkpoint(state, ckpt_dir)
+                        # emergency save is a BARRIER (sync=True): the
+                        # checkpoint must be durable before the process
+                        # exits on the preemption grace window
+                        self.save_checkpoint(state, ckpt_dir, step=step,
+                                             sync=True)
                         self._last_state = state
                         trace_event("train.preempted", cat="resilience",
                                     step=step, ckpt_dir=ckpt_dir)
                         raise Preempted(step=step, ckpt_dir=ckpt_dir)
                 finish_epoch()
+            except HungStepError:
+                # the hung step never completed, so `state` is still the
+                # last COMPLETED boundary state — write a best-effort
+                # emergency checkpoint of it.  If the hung dispatch
+                # already consumed (donated) the state's buffers, the
+                # save fails and the rotation's newest periodic
+                # checkpoint remains the restore point; either way the
+                # abort is clean and a supervisor can resume.
+                if ckpt_dir:
+                    try:
+                        path = self.save_checkpoint(state, ckpt_dir,
+                                                    sync=True)
+                        trace_event("train.hung_step_checkpoint",
+                                    cat="resilience", path=path)
+                    except Exception as e:
+                        get_logger("train").warning(
+                            "emergency checkpoint after hung step "
+                            "failed (donated buffers?): %s", e)
+                raise
             finally:
                 staged.close()
+                self._close_writers()
                 if fit_span is not None:
                     fit_span.finish()
         if ckpt_dir:
-            self.save_checkpoint(state, ckpt_dir)
+            self.save_checkpoint(state, ckpt_dir, sync=True)
+            self._close_writers()
         # the run's loss curve through the typed contract (Metrics.scala:37-47)
         self.training_metric_data().log("train", "debug")
         self._last_state = state  # inspectable (sharding asserts, resume)
@@ -742,6 +893,12 @@ class Trainer:
             get_logger("train").warning(
                 "numerics: loss %s at step %d (loss=%g, threshold=%g)",
                 verdict, step, loss_val, detector.threshold())
+            if verdict == "divergence" and self.config.halt_on_divergence:
+                # same contract as NonFiniteError: raised BEFORE the
+                # step-boundary checkpoint, so the newest checkpoint on
+                # disk is the last pre-divergence state
+                raise DivergenceError(step, loss_val,
+                                      detector.threshold(), ckpt_dir)
 
     def training_metric_data(self) -> MetricData:
         """This trainer's history as a typed metric table (loss/wall plus
@@ -773,26 +930,73 @@ class Trainer:
                                        metadata={"steps": int(state.step)})
 
     # -- checkpoint / resume (absent in the reference; first-class here) --
-    def save_checkpoint(self, state: TrainState, ckpt_dir: str) -> str:
+    def _writer_for(self, ckpt_dir: str) -> CheckpointWriter:
+        writer = self._writers.get(ckpt_dir)
+        if writer is None:
+            writer = self._writers[ckpt_dir] = CheckpointWriter(ckpt_dir)
+        return writer
+
+    def _close_writers(self) -> None:
+        """Drain and stop every checkpoint writer (end-of-fit barrier);
+        best-effort — a failed background write was already surfaced at
+        its submit/drain, and a finally-block close must never mask the
+        exception unwinding through it."""
+        for writer in self._writers.values():
+            writer.close(best_effort=True)
+        self._writers.clear()
+
+    def _ckpt_meta(self, step: int) -> dict:
+        """The elastic-resume meta sidecar: the topology and EFFECTIVE
+        batch size this checkpoint was written under, so a resume onto a
+        different device count can replay the identical data order."""
+        return {
+            "step": int(step),
+            "data_devices": int(self.mesh.shape.get(DATA_AXIS, 1)),
+            "model_devices": int(self.mesh.shape.get(MODEL_AXIS, 1)),
+            "process_count": int(jax.process_count()),
+            "effective_batch_size": self._effective_batch_size,
+            "seed": int(self.config.seed),
+            "rng_fold": int(self.config.rng_fold),
+            "format": 1,
+        }
+
+    def save_checkpoint(self, state: TrainState, ckpt_dir: str, *,
+                        step: Optional[int] = None,
+                        sync: bool = True) -> str:
         """Write one rotation checkpoint (keep-last-K + LATEST pointer +
-        sha256 sidecar, resilience/checkpoints.py); a collective under
-        multi-host (the gather runs on every process, bounded by the
-        collective timeout) but only the coordinator writes, so concurrent
-        hosts sharing a filesystem never race."""
-        with trace_span("checkpoint.save", cat="checkpoint"):
-            dev = run_collective(
-                "checkpoint.gather", lambda: gather_replicated(
-                    {"step": state.step, "params": state.params,
-                     "opt_state": state.opt_state,
-                     "batch_stats": state.batch_stats},
-                    self.mesh))
-            step = int(state.step)
+        sha256 sidecar + elastic meta, resilience/checkpoints.py).
+
+        The gather is a collective under multi-host (it runs on every
+        process, bounded by the collective timeout) but only the
+        coordinator writes, so concurrent hosts sharing a filesystem
+        never race.  The write itself rides the background writer
+        (resilience/ckpt_writer.py): `sync=False` returns right after
+        handing off the gathered device arrays (the step loop's async
+        path — D2H + serialization + disk happen on the writer thread);
+        `sync=True` drains first (emergency/final saves, external
+        callers).  `step` supplies the host-known step so the async path
+        never synchronizes on the device scalar."""
+        with trace_span("checkpoint.save", cat="checkpoint", sync=sync):
+            tree = {"step": state.step, "params": state.params,
+                    "opt_state": state.opt_state,
+                    "batch_stats": state.batch_stats}
+            if jax.process_count() == 1:
+                # every shard is addressable: a same-sharding snapshot
+                # copy is the whole device-side cost (no n_devices-wide
+                # replication) and protects the pending async write from
+                # the next step's buffer donation; the writer assembles
+                # shards during its device_get
+                dev = snapshot_tree(tree)
+            else:
+                dev = run_collective(
+                    "checkpoint.gather",
+                    lambda: gather_replicated(tree, self.mesh))
+            step = int(state.step) if step is None else int(step)
             if not is_coordinator():
                 # the gather ran (collective); skip the D2H copy + write
                 return os.path.join(ckpt_dir, checkpoint_name(step))
-            host = jax.device_get(dev)
-            return write_checkpoint(ckpt_dir, step,
-                                    serialization.to_bytes(host))
+            return self._writer_for(ckpt_dir).submit(
+                step, dev, meta=self._ckpt_meta(step), sync=sync)
 
     def restore_checkpoint(self, state: TrainState, ckpt_dir: str) -> TrainState:
         """Restore from the newest VALID checkpoint in the coordinator's
@@ -801,15 +1005,22 @@ class Trainer:
         coordinator reads the file (matching coordinator-only writes — no
         shared filesystem required); values reach the other hosts via a
         broadcast collective, with a named barrier + bounded waits so a
-        dead peer raises a diagnostic instead of hanging the job."""
+        dead peer raises a diagnostic instead of hanging the job.
+
+        Elastic by construction: the payload holds gathered full-shape
+        arrays and the target layout comes from the LIVE state's
+        shardings (`put_tree_like`), so a checkpoint saved under dp=N
+        restores onto an M-device mesh with byte-identical weights."""
         with trace_span("checkpoint.restore", cat="checkpoint",
                         ckpt_dir=ckpt_dir):
             return self._restore_checkpoint(state, ckpt_dir)
 
     def _restore_checkpoint(self, state: TrainState,
                             ckpt_dir: str) -> TrainState:
-        # from_bytes needs only shapes/dtypes/structure — build the template
-        # locally (no collectives, no D2H of live state)
+        # deserialization needs only shapes/dtypes/structure — build the
+        # template locally (no collectives, no D2H of live state); global
+        # logical shapes are device-count-independent, which is what
+        # makes the restore elastic
         template = jax.tree_util.tree_map(
             lambda a: np.zeros(np.shape(a), a.dtype),
             {"step": state.step, "params": state.params,
@@ -832,11 +1043,8 @@ class Trainer:
             if not readable:
                 raise FileNotFoundError(
                     f"coordinator has no valid checkpoint in {ckpt_dir}")
-            if is_coordinator():
-                with open(path, "rb") as f:
-                    host = serialization.from_bytes(template, f.read())
-            else:
-                host = template
+            host = read_checkpoint(template, path) if is_coordinator() \
+                else template
             restored = run_collective(
                 "restore.broadcast",
                 lambda: multihost_utils.broadcast_one_to_all(host))
@@ -845,15 +1053,11 @@ class Trainer:
             if path is None:
                 raise FileNotFoundError(
                     f"no valid checkpoint in {ckpt_dir}")
-            with open(path, "rb") as f:
-                restored = serialization.from_bytes(template, f.read())
+            restored = read_checkpoint(template, path)
         return TrainState(
             step=jnp.asarray(restored["step"]),
-            params=jax.tree_util.tree_map(put_like, restored["params"],
-                                          state.params),
-            opt_state=jax.tree_util.tree_map(put_like, restored["opt_state"],
-                                             state.opt_state),
-            batch_stats=jax.tree_util.tree_map(put_like,
-                                               restored["batch_stats"],
-                                               state.batch_stats),
+            params=put_tree_like(restored["params"], state.params),
+            opt_state=put_tree_like(restored["opt_state"], state.opt_state),
+            batch_stats=put_tree_like(restored["batch_stats"],
+                                      state.batch_stats),
         )

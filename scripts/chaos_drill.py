@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Chaos drill: run the declarative fault-scenario suite end-to-end.
+
+`make chaos-drill` runs this.  Each scenario trains a small MLP under
+the RecoverySupervisor while the chaos injector executes a scripted
+multi-fault sequence (resilience/chaos.py Scenario DSL), then checks the
+declared expected outcome — completion to the configured step count with
+finite weights and the right number of recoveries, or a clean
+budget-exhausted failure with the last finite checkpoint newest.
+
+The acceptance drill (scenario `env_nan_rollback`) drives the fault the
+way an operator would: MMLSPARK_TPU_CHAOS_NAN_AT_STEP poisons one step,
+and the run must complete with a machine-readable recovery timeline in
+run_summary.json.
+
+Exit code: 0 when every scenario passes, 1 otherwise (one PASS/FAIL
+line per scenario plus a JSON report tail).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+from mmlspark_tpu import config  # noqa: E402
+from mmlspark_tpu.observe.telemetry import run_telemetry  # noqa: E402
+from mmlspark_tpu.resilience import (Fault, Scenario,  # noqa: E402
+                                     latest_valid_checkpoint, reset_chaos,
+                                     run_scenario)
+from mmlspark_tpu.train import (RecoveryBudgetExceeded,  # noqa: E402
+                                RecoveryPolicy, RecoverySupervisor,
+                                TrainerConfig)
+
+TOTAL_STEPS = 16  # 4 epochs x 4 steps (256 rows / batch 64)
+
+
+def drill_config(**kw) -> TrainerConfig:
+    base = dict(
+        architecture="MLPClassifier",
+        model_config={"hidden_sizes": [16], "num_classes": 2,
+                      "dtype": "float32"},
+        optimizer="momentum", learning_rate=0.05, epochs=4, batch_size=64,
+        seed=0, shuffle_each_epoch=False, numerics_cadence=1,
+        halt_on_nonfinite=True, checkpoint_every_steps=1)
+    base.update(kw)
+    return TrainerConfig(**base)
+
+
+def blobs(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    return x, y
+
+
+def run_supervised(cfg: TrainerConfig, policy: RecoveryPolicy) -> dict:
+    """One supervised training run -> the observation dict scenarios
+    check (outcome / steps / recoveries / finite / timeline_events /
+    last_ckpt_finite / summary_recovery_events)."""
+    x, y = blobs()
+    obs: dict = {}
+    with tempfile.TemporaryDirectory() as root:
+        ckpt = os.path.join(root, "ckpt")
+        tel = os.path.join(root, "telemetry")
+        sup = RecoverySupervisor(cfg, policy)
+        with run_telemetry(tel):
+            try:
+                bundle = sup.fit_arrays(x, y, ckpt_dir=ckpt)
+                obs["outcome"] = "completed"
+                obs["steps"] = int(bundle.metadata["steps"])
+                obs["finite"] = bool(all(
+                    np.isfinite(np.asarray(v)).all()
+                    for v in jax.tree_util.tree_leaves(bundle.variables)))
+            except RecoveryBudgetExceeded:
+                obs["outcome"] = "gave_up"
+        obs["recoveries"] = sup.recoveries
+        obs["timeline_events"] = len(sup.timeline)
+        # the newest on-disk checkpoint must be restorable and finite —
+        # the raise-before-write contract, checked after EVERY scenario
+        newest = latest_valid_checkpoint(ckpt)
+        if newest is not None:
+            from mmlspark_tpu.train import Trainer
+            probe = Trainer(drill_config())
+            state = probe.init_state((1, 4), total_steps=1)
+            restored = probe.restore_checkpoint(state, ckpt)
+            obs["last_ckpt_finite"] = bool(all(
+                np.isfinite(np.asarray(v)).all()
+                for v in jax.tree_util.tree_leaves(restored.params)))
+        summary_path = os.path.join(tel, "run_summary.json")
+        if os.path.exists(summary_path):
+            with open(summary_path) as f:
+                obs["summary_recovery_events"] = len(
+                    json.load(f).get("recovery", []))
+    return obs
+
+
+def scenarios() -> list:
+    plain = RecoveryPolicy(max_recoveries=3)
+    return [
+        # multi-fault: a NaN mid-run AND a simulated preemption later;
+        # the supervisor must roll back past the first and resume
+        # in-process through the second
+        (Scenario(
+            name="nan_then_preempt",
+            faults=[Fault("nan", step=5), Fault("sigterm", step=11)],
+            expect={"outcome": "completed", "steps": TOTAL_STEPS,
+                    "finite": True, "min_recoveries": 1,
+                    "min_summary_recovery_events": 2}),
+         drill_config(),
+         RecoveryPolicy(max_recoveries=3, resume_on_preemption=True)),
+        # torn rotation artifacts, one scenario per corruption surface:
+        # restore must keep landing on a valid finite checkpoint
+        *[(Scenario(
+            name=f"torn_{target}",
+            faults=[Fault("nan", step=6),
+                    Fault("tear", at_write=4, target=target)],
+            expect={"outcome": "completed", "steps": TOTAL_STEPS,
+                    "finite": True, "last_ckpt_finite": True}),
+           drill_config(), plain)
+          for target in ("payload", "sidecar", "latest")],
+        # hung step: the watchdog converts a 0.5s stall (deadline 0.1s)
+        # into HungStepError; the supervisor restores and resumes
+        (Scenario(
+            name="hung_step_watchdog",
+            faults=[Fault("hang", step=4, seconds=0.5)],
+            expect={"outcome": "completed", "steps": TOTAL_STEPS,
+                    "finite": True, "min_recoveries": 1}),
+         drill_config(step_timeout_s=0.1), plain),
+        # budget exhaustion: more poisons than the budget allows — the
+        # supervisor must give up CLEANLY with the newest checkpoint
+        # still the last finite state
+        (Scenario(
+            name="budget_exhausted",
+            faults=[Fault("nan", step=s) for s in (3, 4, 5, 6)],
+            expect={"outcome": "gave_up", "min_recoveries": 2,
+                    "last_ckpt_finite": True}),
+         drill_config(),
+         RecoveryPolicy(max_recoveries=1)),
+    ]
+
+
+def run_env_nan_drill() -> dict:
+    """The acceptance drill: MMLSPARK_TPU_CHAOS_NAN_AT_STEP (the
+    operator-facing env knob) poisons one step; the supervised run must
+    complete to the configured step count with finite weights and a
+    recovery timeline in run_summary.json."""
+    config.set("MMLSPARK_TPU_CHAOS_NAN_AT_STEP", 5)
+    reset_chaos()
+    try:
+        obs = run_supervised(drill_config(), RecoveryPolicy(max_recoveries=2))
+    finally:
+        config.set("MMLSPARK_TPU_CHAOS_NAN_AT_STEP", None)
+        reset_chaos()
+    checks = {
+        "outcome": obs.get("outcome") == "completed",
+        "steps": obs.get("steps") == TOTAL_STEPS,
+        "finite": obs.get("finite") is True,
+        "recovered": obs.get("recoveries", 0) >= 1,
+        "timeline_in_run_summary": obs.get("summary_recovery_events", 0) >= 2,
+    }
+    return {"name": "env_nan_rollback", "passed": all(checks.values()),
+            "checks": {k: {"ok": v} for k, v in checks.items()},
+            "observed": obs}
+
+
+def main() -> int:
+    reports = [run_env_nan_drill()]
+    for scenario, cfg, policy in scenarios():
+        reports.append(run_scenario(
+            scenario, lambda c=cfg, p=policy: run_supervised(c, p)))
+    failed = [r for r in reports if not r["passed"]]
+    for r in reports:
+        print(f"{'PASS' if r['passed'] else 'FAIL'}  {r['name']}")
+    print(json.dumps({"scenarios": len(reports),
+                      "failed": [r["name"] for r in failed],
+                      "reports": reports}, indent=1, default=str))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,9 @@ echo "== multichip dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+echo "== chaos drill (multi-fault recovery scenarios) =="
+python scripts/chaos_drill.py
+
 echo "== bench smoke (JSON contract) =="
 python bench.py --smoke
 

@@ -28,6 +28,11 @@ Checks, per file:
     pipeline stage spans), so every measured second is attributed and
     exported; the one sanctioned coarse clock is
     `observe.spans.monotonic` (epoch wall fields)
+  * synchronous checkpoint serialization inside `mmlspark_tpu/train/` —
+    `to_bytes`/`from_bytes`/`write_checkpoint` calls there mean the step
+    loop is paying D2H + msgpack + disk inline; checkpoint serialization
+    lives in `resilience/ckpt_writer.py` (the background writer thread)
+    and the trainer only hands gathered device arrays to it
   * implicit float64 promotion in hot-loop modules — `np.float64`/
     `np.double` references, and `asarray`/`array` calls whose argument is
     a bare python list/tuple literal (or comprehension) with no dtype:
@@ -69,6 +74,12 @@ HOT_LOOP_DIRS = {
     os.path.join("mmlspark_tpu", "quant"),
 }
 
+# the trainer package: checkpoint serialization is forbidden here — it
+# belongs on the resilience/ckpt_writer.py writer thread, so a
+# synchronous save can never creep back into the step loop
+TRAIN_DIR = os.path.join("mmlspark_tpu", "train")
+_CKPT_SERIALIZE_CALLS = ("to_bytes", "from_bytes", "write_checkpoint")
+
 # the framework package: raw print()/root-logger output is forbidden here
 # (route through observe.logging); the report CLI is the one whitelisted
 # producer of stdout text
@@ -95,6 +106,19 @@ def _in_hot_loop(path: str) -> bool:
 
 def _in_resilience(path: str) -> bool:
     return os.path.normpath(path).startswith(RESILIENCE_DIR + os.sep)
+
+
+def _in_train(path: str) -> bool:
+    return os.path.normpath(path).startswith(TRAIN_DIR + os.sep)
+
+
+def _is_ckpt_serialize_call(node: ast.Call) -> bool:
+    """Matches `serialization.to_bytes(...)`, bare `to_bytes(...)`,
+    `from_bytes`, and `write_checkpoint` calls (any attribute chain)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id in _CKPT_SERIALIZE_CALLS
+    return isinstance(fn, ast.Attribute) and fn.attr in _CKPT_SERIALIZE_CALLS
 
 
 def _is_device_put_call(node: ast.Call) -> bool:
@@ -224,7 +248,16 @@ def check_file(path: str) -> list[str]:
     in_resilience = _in_resilience(path)
     in_hot_loop = _in_hot_loop(path)
     in_package = _in_package(path)
+    in_train = _in_train(path)
     for node in ast.walk(tree):
+        if in_train and isinstance(node, ast.Call) \
+                and _is_ckpt_serialize_call(node):
+            problems.append(
+                f"{path}:{node.lineno}: synchronous checkpoint "
+                f"serialization in mmlspark_tpu/train/ — to_bytes/"
+                f"from_bytes/write_checkpoint belong on the "
+                f"resilience/ckpt_writer.py writer thread "
+                f"(CheckpointWriter.submit / read_checkpoint)")
         if in_package and isinstance(node, ast.Call):
             if _is_print_call(node):
                 problems.append(

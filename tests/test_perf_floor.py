@@ -114,6 +114,32 @@ def test_bench_contract_fields():
     assert result["telemetry_overhead"] <= 0.03, result
 
 
+def test_bench_checkpoint_contract_fields():
+    """bench_checkpoint (docs/resilience.md "Async checkpointing"): with
+    the writer thread owning serialization + disk, per-step wall at
+    checkpoint steps must sit within noise of ordinary steps — while the
+    sync arm in the SAME invocation shows what inline saves cost.  Both
+    ratios are medians of boundary-to-boundary step gaps, so the pin is
+    robust to a single scheduler hiccup."""
+    import bench
+    result = bench.bench_checkpoint(smoke=True)
+    assert {"metric", "value", "unit", "vs_baseline",
+            "async_ckpt_step_ratio", "sync_ckpt_step_ratio",
+            "checkpoint_every", "steps",
+            "checkpoint_dir_bytes"} <= set(result)
+    assert result["metric"] == "trainer_async_checkpoint_step_overhead"
+    assert result["checkpoint_dir_bytes"] > 0
+    assert result["steps"] >= 16
+    # the async claim: checkpoint-step cost within noise of ordinary
+    # steps (measured ~0.9-1.1 standalone, up to ~1.3 inside a loaded
+    # full-suite process; the sync arm measures ~3x on the same
+    # workload, so 1.5 still cleanly rejects a synchronous regression)
+    assert result["async_ckpt_step_ratio"] <= 1.5, result
+    # and async never costs more than sync on the same workload
+    assert result["async_ckpt_step_ratio"] <= \
+        result["sync_ckpt_step_ratio"] + 0.1, result
+
+
 def test_bench_decode_contract_fields():
     """bench_lm_decode's extended schema (docs/performance.md decode
     engine): the original fields stay byte-compatible, the occupancy

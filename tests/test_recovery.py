@@ -1,0 +1,389 @@
+"""Self-healing training runtime (train/supervisor.py + the trainer's
+async checkpointing / watchdog / skip-window machinery) on the 8-device
+CPU mesh: auto-rollback recovery, recovery budgets, hung-step watchdog,
+chaos scenario runner, and the run_summary recovery timeline."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from mmlspark_tpu import config
+from mmlspark_tpu.observe.numerics import NonFiniteError
+from mmlspark_tpu.observe.telemetry import run_telemetry
+from mmlspark_tpu.resilience import (ChaosInjector, Fault, HungStepError,
+                                     Scenario, latest_valid_checkpoint,
+                                     list_checkpoints, reset_chaos,
+                                     run_scenario, set_injector)
+from mmlspark_tpu.resilience.checkpoints import step_of
+from mmlspark_tpu.train import (RecoveryBudgetExceeded, RecoveryPolicy,
+                                RecoverySupervisor, Trainer, TrainerConfig)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    reset_chaos()
+    yield
+    reset_chaos()
+
+
+def blob_data(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    return x, y
+
+
+def drill_config(**kw) -> TrainerConfig:
+    base = dict(
+        architecture="MLPClassifier",
+        model_config={"hidden_sizes": [16], "num_classes": 2,
+                      "dtype": "float32"},
+        optimizer="momentum", learning_rate=0.05, epochs=4, batch_size=64,
+        seed=0, shuffle_each_epoch=False, numerics_cadence=1,
+        halt_on_nonfinite=True, checkpoint_every_steps=1)
+    base.update(kw)
+    return TrainerConfig(**base)
+
+
+def finite_tree(tree) -> bool:
+    return all(np.isfinite(np.asarray(v)).all()
+               for v in jax.tree_util.tree_leaves(tree))
+
+
+def scripted(*faults):
+    """Install a script-driven injector; returns a restore callable."""
+    previous = set_injector(ChaosInjector(script=list(faults)))
+    return lambda: set_injector(previous)
+
+
+# ------------------------------------------------ the acceptance drill ---
+
+def test_supervisor_nan_rollback_completes_with_timeline(tmp_path):
+    """THE acceptance scenario: MMLSPARK_TPU_CHAOS_NAN_AT_STEP poisons
+    one step; the supervisor rolls back to the last finite checkpoint,
+    skips the poisoned window, and training completes to the configured
+    step count with finite weights and a machine-readable recovery
+    timeline in run_summary.json."""
+    x, y = blob_data()
+    cfg = drill_config()                 # 4 epochs x 4 steps = 16
+    config.set("MMLSPARK_TPU_CHAOS_NAN_AT_STEP", 5)
+    reset_chaos()
+    try:
+        sup = RecoverySupervisor(cfg, RecoveryPolicy(max_recoveries=2))
+        tel = str(tmp_path / "tel")
+        with run_telemetry(tel):
+            bundle = sup.fit_arrays(x, y, ckpt_dir=str(tmp_path / "ckpt"))
+    finally:
+        config.set("MMLSPARK_TPU_CHAOS_NAN_AT_STEP", None)
+        reset_chaos()
+    assert bundle.metadata["steps"] == 16     # the CONFIGURED step count
+    assert finite_tree(bundle.variables)
+    assert sup.recoveries == 1
+    events = [e["event"] for e in sup.timeline]
+    assert events == ["failure", "recover", "completed"]
+    assert sup.timeline[0]["kind"] == "nonfinite"
+    assert sup.timeline[1]["skip_window"] == [5, 5]
+    # machine-readable timeline in run_summary.json
+    with open(os.path.join(tel, "run_summary.json")) as f:
+        summary = json.load(f)
+    assert [e["event"] for e in summary["recovery"]] == events
+    assert summary["recovery"][0]["step"] == 5
+
+
+def test_budget_exhaustion_fails_cleanly_last_finite_newest(tmp_path):
+    """More poisons than budget: RecoveryBudgetExceeded carries the full
+    timeline, and the newest on-disk checkpoint is still finite (the
+    raise-before-write contract held on every attempt)."""
+    x, y = blob_data()
+    restore = scripted(*[Fault("nan", step=s) for s in (3, 4, 5, 6)])
+    try:
+        sup = RecoverySupervisor(drill_config(),
+                                 RecoveryPolicy(max_recoveries=1))
+        with pytest.raises(RecoveryBudgetExceeded) as ei:
+            sup.fit_arrays(x, y, ckpt_dir=str(tmp_path))
+    finally:
+        restore()
+    assert ei.value.recoveries == 1
+    assert isinstance(ei.value.__cause__, NonFiniteError)
+    assert [e["event"] for e in ei.value.timeline] == \
+        ["failure", "recover", "failure", "gave_up"]
+    # the newest valid checkpoint restores to a finite state
+    newest = latest_valid_checkpoint(str(tmp_path))
+    assert newest is not None
+    probe = Trainer(drill_config())
+    state = probe.init_state((1, 4), total_steps=1)
+    restored = probe.restore_checkpoint(state, str(tmp_path))
+    assert finite_tree(restored.params)
+
+
+def test_recovery_policy_backoff_and_refold(tmp_path):
+    """lr_backoff scales the retry's learning rate and refold_rng folds
+    the recovery count into the data-order stream."""
+    x, y = blob_data()
+    restore = scripted(Fault("nan", step=5))
+    try:
+        sup = RecoverySupervisor(
+            drill_config(),
+            RecoveryPolicy(max_recoveries=2, lr_backoff=0.5,
+                           refold_rng=True))
+        bundle = sup.fit_arrays(x, y, ckpt_dir=str(tmp_path))
+    finally:
+        restore()
+    assert bundle.metadata["steps"] == 16
+    assert sup.trainer.config.learning_rate == pytest.approx(0.025)
+    assert sup.trainer.config.rng_fold == 1
+    recover = next(e for e in sup.timeline if e["event"] == "recover")
+    assert recover["lr_scale"] == pytest.approx(0.5)
+    assert recover["rng_fold"] == 1
+
+
+def test_supervisor_requires_checkpoint_dir():
+    with pytest.raises(ValueError, match="checkpoint directory"):
+        RecoverySupervisor(drill_config()).fit_arrays(*blob_data())
+
+
+def test_divergence_halt_feeds_supervisor(tmp_path):
+    """halt_on_divergence turns a sustained loss explosion into a
+    DivergenceError at the step boundary (before the checkpoint write),
+    which the supervisor treats exactly like a NaN."""
+    from mmlspark_tpu.observe.numerics import DivergenceError, LossSpikeDetector
+    det = LossSpikeDetector(warmup=3, div_consecutive=2)
+    for v in (1.0, 1.01, 0.99, 1.0, 1.02):
+        assert det.update(v) == "ok"
+    assert det.update(50.0) == "spike"
+    assert det.update(55.0) == "divergence"
+    err = DivergenceError(7, 55.0, det.threshold(), str(tmp_path))
+    assert err.step == 7 and "divergence" in str(err)
+
+
+# ------------------------------------------------------- skip windows ---
+
+def test_skip_window_preserves_step_count_and_skips_data(tmp_path):
+    """Skipped steps advance the counter (total/checkpoint numbering
+    preserved) but run no update: weights after a skip-window run differ
+    from the plain run, and the skipped step emits a resilience event."""
+    x, y = blob_data()
+    cfg = drill_config(checkpoint_every_steps=0, numerics_cadence=0)
+    plain = Trainer(cfg).fit_arrays(x, y)
+    with run_telemetry(None) as rt:
+        skipped = Trainer(cfg).fit_arrays(
+            x, y, skip_data_windows=[(2, 3)])
+    assert plain.metadata["steps"] == skipped.metadata["steps"] == 16
+    w_plain = np.asarray(plain.variables["params"]["dense0"]["kernel"])
+    w_skip = np.asarray(skipped.variables["params"]["dense0"]["kernel"])
+    assert not np.allclose(w_plain, w_skip)
+    ev = [r for r in rt.tracer.records()
+          if r.get("name") == "train.step_skipped"]
+    assert [e["attrs"]["step"] for e in ev] == [2, 3]
+
+
+def test_rng_fold_changes_shuffle_order_only_when_set():
+    x, y = blob_data()
+    cfg = drill_config(checkpoint_every_steps=0, numerics_cadence=0,
+                       shuffle_each_epoch=True, epochs=2)
+    a = Trainer(cfg).fit_arrays(x, y)
+    b = Trainer(cfg).fit_arrays(x, y)
+    c = Trainer(TrainerConfig(**{**cfg.to_json(), "rng_fold": 1,
+                                 "mesh": cfg.mesh})).fit_arrays(x, y)
+    wa = np.asarray(a.variables["params"]["dense0"]["kernel"])
+    wb = np.asarray(b.variables["params"]["dense0"]["kernel"])
+    wc = np.asarray(c.variables["params"]["dense0"]["kernel"])
+    np.testing.assert_array_equal(wa, wb)   # fold 0: byte-identical
+    assert not np.array_equal(wa, wc)       # fold 1: different shuffles
+
+
+# -------------------------------------------------- hung-step watchdog ---
+
+def test_watchdog_raises_hung_step_and_checkpoints(tmp_path):
+    """A chaos hang past step_timeout_s raises HungStepError; the newest
+    checkpoint is the last completed step's emergency save."""
+    x, y = blob_data()
+    restore = scripted(Fault("hang", step=4, seconds=0.5))
+    try:
+        with pytest.raises(HungStepError) as ei:
+            Trainer(drill_config(step_timeout_s=0.1,
+                                 numerics_cadence=0)).fit_arrays(
+                x, y, ckpt_dir=str(tmp_path))
+    finally:
+        restore()
+    assert ei.value.step == 4
+    newest = latest_valid_checkpoint(str(tmp_path))
+    assert newest is not None
+    assert step_of(os.path.basename(newest)) == 4  # pre-hang state
+
+
+def test_supervisor_recovers_from_hung_step(tmp_path):
+    x, y = blob_data()
+    restore = scripted(Fault("hang", step=4, seconds=0.5))
+    try:
+        sup = RecoverySupervisor(
+            drill_config(step_timeout_s=0.1, numerics_cadence=0),
+            RecoveryPolicy(max_recoveries=2))
+        bundle = sup.fit_arrays(x, y, ckpt_dir=str(tmp_path))
+    finally:
+        restore()
+    assert bundle.metadata["steps"] == 16
+    assert finite_tree(bundle.variables)
+    assert sup.timeline[0]["kind"] == "hung_step"
+
+
+def test_watchdog_off_by_default_and_validates():
+    from mmlspark_tpu.resilience import StepWatchdog
+    assert drill_config().step_timeout_s == 0.0
+    with pytest.raises(ValueError):
+        StepWatchdog(0.0)
+    # a fast step passes through with its result
+    assert StepWatchdog(5.0).run(lambda: 42, step=0) == 42
+    with pytest.raises(RuntimeError, match="boom"):
+        StepWatchdog(5.0).run(lambda: (_ for _ in ()).throw(
+            RuntimeError("boom")), step=0)
+
+
+# ------------------------------------------------- preemption resume ---
+
+def test_supervisor_preemption_reraises_by_default(tmp_path):
+    from mmlspark_tpu.resilience import Preempted
+    x, y = blob_data()
+    config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", 5)
+    reset_chaos()
+    try:
+        sup = RecoverySupervisor(drill_config(numerics_cadence=0))
+        with pytest.raises(Preempted):
+            sup.fit_arrays(x, y, ckpt_dir=str(tmp_path))
+    finally:
+        config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", None)
+        reset_chaos()
+    assert sup.timeline[-1]["event"] == "preempted"
+    assert sup.timeline[-1]["resumed_in_process"] is False
+
+
+def test_supervisor_preemption_resume_in_process(tmp_path):
+    """resume_on_preemption continues after a simulated SIGTERM without
+    consuming the failure budget; the final weights match a fault-free
+    run (same data order, exact resume)."""
+    x, y = blob_data()
+    cfg = drill_config(numerics_cadence=0)
+    ref = Trainer(cfg).fit_arrays(x, y)
+    config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", 5)
+    reset_chaos()
+    try:
+        sup = RecoverySupervisor(
+            cfg, RecoveryPolicy(resume_on_preemption=True))
+        bundle = sup.fit_arrays(x, y, ckpt_dir=str(tmp_path))
+    finally:
+        config.set("MMLSPARK_TPU_CHAOS_PREEMPT_AT_STEP", None)
+        reset_chaos()
+    assert bundle.metadata["steps"] == ref.metadata["steps"] == 16
+    assert sup.recoveries == 0 and sup.preemption_resumes == 1
+    np.testing.assert_allclose(
+        np.asarray(bundle.variables["params"]["dense0"]["kernel"]),
+        np.asarray(ref.variables["params"]["dense0"]["kernel"]),
+        atol=1e-6)
+
+
+# -------------------------------------------------- async checkpointing ---
+
+def test_async_matches_sync_final_weights_and_rotation(tmp_path):
+    """Async and sync checkpointing produce identical training results
+    and equivalent rotations (same newest step, valid checksums)."""
+    x, y = blob_data()
+    outs = {}
+    for mode in (True, False):
+        d = str(tmp_path / ("async" if mode else "sync"))
+        cfg = drill_config(async_checkpointing=mode, numerics_cadence=0,
+                           checkpoint_every_steps=2)
+        outs[mode] = Trainer(cfg).fit_arrays(x, y, ckpt_dir=d)
+        steps = [s for s, _ in list_checkpoints(d)]
+        assert steps[0] == 16            # final sync save is newest
+        assert latest_valid_checkpoint(d) is not None
+    np.testing.assert_array_equal(
+        np.asarray(outs[True].variables["params"]["dense0"]["kernel"]),
+        np.asarray(outs[False].variables["params"]["dense0"]["kernel"]))
+
+
+def test_async_writer_failure_surfaces_in_fit(tmp_path):
+    """A background write failure must fail the fit at the next
+    checkpoint boundary, not vanish."""
+    from mmlspark_tpu.resilience import CheckpointWriteError
+    x, y = blob_data()
+    blocker = tmp_path / "ckpt"
+    blocker.write_bytes(b"a file where the directory should be")
+    cfg = drill_config(numerics_cadence=0, checkpoint_every_steps=2)
+    with pytest.raises(CheckpointWriteError):
+        Trainer(cfg).fit_arrays(x, y, ckpt_dir=str(blocker))
+
+
+def test_elastic_meta_written_with_checkpoint(tmp_path):
+    from mmlspark_tpu.resilience import checkpoint_meta
+    x, y = blob_data()
+    cfg = drill_config(numerics_cadence=0)
+    Trainer(cfg).fit_arrays(x, y, ckpt_dir=str(tmp_path))
+    meta = checkpoint_meta(latest_valid_checkpoint(str(tmp_path)))
+    assert meta["data_devices"] == 8
+    assert meta["effective_batch_size"] == 64
+    assert meta["step"] == 16
+    assert meta["process_count"] == 1
+
+
+# --------------------------------------------------- scenario runner ---
+
+def test_scenario_runner_checks_and_isolation(tmp_path):
+    """run_scenario installs the script injector for the workload only,
+    evaluates min_/max_/exact expectations, and restores the previous
+    injector afterwards."""
+    from mmlspark_tpu.resilience.chaos import get_injector
+    before = get_injector()
+    seen = {}
+
+    def run_fn():
+        seen["injector"] = get_injector()
+        return {"outcome": "completed", "recoveries": 2, "steps": 16}
+
+    report = run_scenario(Scenario(
+        name="demo",
+        faults=[Fault("nan", step=3)],
+        expect={"outcome": "completed", "min_recoveries": 1,
+                "max_recoveries": 3, "steps": 16, "min_missing": 1}),
+        run_fn)
+    assert get_injector() is before            # restored
+    assert seen["injector"].script[0].kind == "nan"
+    assert report["checks"]["outcome"]["ok"]
+    assert report["checks"]["min_recoveries"]["ok"]
+    assert report["checks"]["max_recoveries"]["ok"]
+    assert not report["checks"]["min_missing"]["ok"]   # absent key fails
+    assert report["passed"] is False
+
+
+def test_multi_fault_scenario_end_to_end(tmp_path):
+    """The ISSUE's flagship script: NaN at one step + SIGTERM later +
+    a torn rotation artifact, declared as ONE scenario — the supervised
+    run must absorb all three and complete."""
+    x, y = blob_data()
+
+    def run_fn():
+        sup = RecoverySupervisor(
+            drill_config(),
+            RecoveryPolicy(max_recoveries=3, resume_on_preemption=True))
+        bundle = sup.fit_arrays(x, y, ckpt_dir=str(tmp_path / "ckpt"))
+        return {"outcome": "completed",
+                "steps": int(bundle.metadata["steps"]),
+                "recoveries": sup.recoveries,
+                "finite": finite_tree(bundle.variables)}
+
+    report = run_scenario(Scenario(
+        name="nan_preempt_tear",
+        faults=[Fault("nan", step=5), Fault("sigterm", step=11),
+                Fault("tear", at_write=3, target="sidecar")],
+        expect={"outcome": "completed", "steps": 16, "finite": True,
+                "min_recoveries": 1}), run_fn)
+    assert report["passed"], report
+
+
+def test_fault_validation():
+    with pytest.raises(ValueError, match="fault kind"):
+        Fault("meteor", step=1)
+    with pytest.raises(ValueError, match="tear target"):
+        Fault("tear", target="everything")

@@ -497,3 +497,203 @@ def test_read_images_iter_on_error_column(mixed_image_dir):
     assert sum(b.num_rows for b in batches) == 3
     errs = [e for b in batches for e in b["decode_error"]]
     assert sum(e is not None for e in errs) == 1
+
+
+def test_skipped_rows_surface_as_counter_and_event(mixed_image_dir):
+    """on_error='skip' drops are never silent at the run level: the
+    rows.skipped_on_error counter moves and a cat=resilience event rides
+    the ambient run's stream (so run_summary counters + the run-report
+    resilience timeline both show the loss)."""
+    from mmlspark_tpu.io.image_reader import read_images, read_images_iter
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+    with run_telemetry(None) as rt:
+        read_images(mixed_image_dir, on_error="skip")
+        assert get_counter("rows.skipped_on_error") == 1
+        list(read_images_iter(mixed_image_dir, batch_size=2,
+                              resize_to=(4, 4), on_error="skip"))
+        assert get_counter("rows.skipped_on_error") == 2
+    assert rt.summary()["counters"]["rows.skipped_on_error"] == 2
+    events = [r for r in rt.tracer.records()
+              if r.get("name") == "rows.skipped"]
+    assert len(events) >= 2
+    assert all(e["cat"] == "resilience" for e in events)
+    assert {e["attrs"]["stage"] for e in events} == {"read_images",
+                                                     "read_images_iter"}
+
+
+# --------------------------------------------- checkpoint-dir hygiene ---
+
+def test_orphan_tmps_swept_on_rotation_open(tmp_path):
+    """A writer killed mid-write leaves only .tmp orphans (atomic
+    tmp+rename); both rotation entry points sweep them."""
+    from mmlspark_tpu.resilience import sweep_orphan_tmps
+    d = str(tmp_path)
+    write_checkpoint(d, 1, b"good", keep=3)
+    for orphan in ("ckpt_0000000002.msgpack.tmp",
+                   "ckpt_0000000002.msgpack.sha256.tmp", "LATEST.tmp"):
+        (tmp_path / orphan).write_bytes(b"torn mid-write")
+    # restore-side sweep
+    assert latest_valid_checkpoint(d) is not None
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+    assert get_counter("checkpoint.orphan_tmps_swept") == 3
+    # write-side sweep
+    (tmp_path / "ckpt_0000000003.msgpack.tmp").write_bytes(b"torn")
+    write_checkpoint(d, 3, b"next", keep=3)
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+    # idempotent no-op on clean/missing dirs
+    assert sweep_orphan_tmps(d) == 0
+    assert sweep_orphan_tmps(str(tmp_path / "missing")) == 0
+
+
+# ------------------------------------------------- torn-artifact matrix ---
+
+@pytest.mark.parametrize("target", ["payload", "sidecar", "latest"])
+def test_restore_skips_torn_artifact(tmp_path, target):
+    """All three corruption surfaces a crash can leave — torn payload,
+    torn sha256 sidecar, torn LATEST pointer — must leave restore on a
+    VALID checkpoint (the previous one for payload/sidecar tears, the
+    still-intact newest for a pointer tear)."""
+    d = str(tmp_path)
+    for step in (1, 2, 3):
+        write_checkpoint(d, step, f"payload-{step}".encode() * 10, keep=5)
+    newest = os.path.join(d, "ckpt_0000000003.msgpack")
+    ChaosInjector.tear_checkpoint(newest, target)
+    best = latest_valid_checkpoint(d)
+    assert best is not None
+    with open(best, "rb") as f:
+        data = f.read()
+    if target == "latest":
+        assert data == b"payload-3" * 10   # payload intact; pointer junk
+    else:
+        assert data == b"payload-2" * 10   # fell back past the tear
+
+
+@pytest.mark.parametrize("target", ["payload", "sidecar", "latest"])
+def test_chaos_tear_target_via_config(tmp_path, override, target):
+    """MMLSPARK_TPU_CHAOS_TORN_CKPT_TARGET steers the probabilistic torn-
+    checkpoint fault onto any of the three surfaces."""
+    override("MMLSPARK_TPU_CHAOS_TORN_CKPT_RATE", 1.0)
+    override("MMLSPARK_TPU_CHAOS_TORN_CKPT_TARGET", target)
+    reset_chaos()
+    d = str(tmp_path)
+    write_checkpoint(d, 1, b"x" * 100, keep=5)
+    assert get_counter("chaos.torn_files") == 1
+
+
+def test_scripted_tear_survives_prune(tmp_path):
+    """Scenario tears land AFTER prune (after_checkpoint_write), so the
+    corrupt state persists on disk for restore to prove it skips it."""
+    from mmlspark_tpu.resilience import Fault, set_injector
+    previous = set_injector(ChaosInjector(script=[
+        Fault("tear", at_write=2, target="payload")]))
+    try:
+        d = str(tmp_path)
+        write_checkpoint(d, 1, b"first" * 10, keep=3)
+        write_checkpoint(d, 2, b"second" * 10, keep=3)  # torn post-prune
+        # the torn newest is still ON DISK (prune ran before the tear)...
+        steps = [s for s, _ in list_checkpoints(d)]
+        assert steps == [2, 1]
+        # ...and restore skips it to the previous valid checkpoint
+        best = latest_valid_checkpoint(d)
+        with open(best, "rb") as f:
+            assert f.read() == b"first" * 10
+        assert get_counter("checkpoint.skipped_corrupt") >= 1
+    finally:
+        set_injector(previous)
+
+
+# ---------------------------------------------- crash-mid-write fuzzing ---
+
+def test_crash_mid_rotation_fuzz(tmp_path):
+    """Kill-the-writer fuzz: simulate a crash at randomized byte offsets
+    through the rotation write protocol (sidecar tmp -> sidecar rename ->
+    meta -> payload tmp -> payload rename -> LATEST).  Whatever prefix of
+    that sequence completed — including partial file contents — restore
+    must always land on a valid, loadable checkpoint."""
+    import hashlib as _hashlib
+    import shutil
+    rng = np.random.default_rng(7)
+    base = tmp_path / "base"
+    base.mkdir()
+    write_checkpoint(str(base), 1, b"known-good-payload" * 20, keep=5)
+    payload = b"next-checkpoint-payload" * 20
+    sha = _hashlib.sha256(payload).hexdigest().encode()
+    name = "ckpt_0000000002.msgpack"
+    for trial in range(25):
+        d = tmp_path / f"trial{trial}"
+        shutil.copytree(base, d)
+        # the write protocol as (path, bytes, is_rename) micro-steps
+        steps = [
+            (d / (name + ".sha256.tmp"), sha, False),
+            ("rename", name + ".sha256"),
+            (d / (name + ".tmp"), payload, False),
+            ("rename", name),
+            (d / "LATEST.tmp", name.encode(), False),
+            ("rename", "LATEST"),
+        ]
+        crash_at = int(rng.integers(0, len(steps) + 1))
+        for i, step in enumerate(steps):
+            if i > crash_at:
+                break
+            if step[0] == "rename":
+                src = d / (step[1] + ".tmp")
+                if src.exists():
+                    os.replace(src, d / step[1])
+            else:
+                path, data, _ = step
+                cut = len(data) if i < crash_at else \
+                    int(rng.integers(1, len(data) + 1))
+                path.write_bytes(data[:cut])  # torn at a random offset
+        best = latest_valid_checkpoint(str(d))
+        assert best is not None, f"trial {trial}: no valid checkpoint"
+        with open(best, "rb") as f:
+            got = f.read()
+        assert got in (b"known-good-payload" * 20, payload), (
+            f"trial {trial}: restored torn bytes")
+
+
+# ------------------------------------------------- async ckpt writer ---
+
+def test_ckpt_writer_writes_rotation_with_meta(tmp_path):
+    from mmlspark_tpu.resilience import CheckpointWriter, checkpoint_meta
+    w = CheckpointWriter(str(tmp_path))
+    try:
+        for step in (1, 2):
+            w.submit(step, {"a": np.arange(step + 1)},
+                     meta={"step": step, "data_devices": 8})
+        w.drain()
+        steps = [s for s, _ in list_checkpoints(str(tmp_path))]
+        assert steps == [2, 1]
+        best = latest_valid_checkpoint(str(tmp_path))
+        assert checkpoint_meta(best) == {"step": 2, "data_devices": 8}
+        assert get_counter("checkpoint.async_writes") == 2
+    finally:
+        w.close()
+
+
+def test_ckpt_writer_error_surfaces_on_drain(tmp_path):
+    """A writer-thread failure is latched and re-raised from the next
+    submit/drain — async never silently drops a checkpoint."""
+    from mmlspark_tpu.resilience import (CheckpointWriteError,
+                                         CheckpointWriter)
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_bytes(b"file where the ckpt dir should be")
+    w = CheckpointWriter(str(blocker))
+    w.submit(1, {"a": np.arange(3)})
+    with pytest.raises(CheckpointWriteError):
+        w.drain()
+    assert get_counter("checkpoint.async_write_failures") == 1
+    w.close(best_effort=True)
+
+
+def test_ckpt_writer_meta_corruption_is_advisory(tmp_path):
+    """A torn .meta.json must never block a restore: checkpoint_meta
+    degrades to None and the payload stays valid."""
+    from mmlspark_tpu.resilience import checkpoint_meta
+    d = str(tmp_path)
+    write_checkpoint(d, 1, b"payload", keep=3, meta={"step": 1})
+    path = latest_valid_checkpoint(d)
+    with open(path + ".meta.json", "w") as f:
+        f.write('{"step": 1, "data_')   # torn json
+    assert checkpoint_meta(path) is None
+    assert latest_valid_checkpoint(d) == path

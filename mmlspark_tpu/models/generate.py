@@ -497,6 +497,24 @@ def _make_sampler(temperature: float, top_k, top_p):
     return sample
 
 
+def _make_row_sampler(temperature: float, top_k, top_p):
+    """The per-row-STEP form of `_make_sampler`: `steps` is a (B,) vector,
+    so rows at different decode offsets (the serving engine's continuous
+    batch) draw from exactly the stream positions the uniform-step batch
+    path would have given them — fold_in(row_key, step) per row."""
+    if temperature <= 0.0:
+        def sample(logits, row_keys, steps):
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        def sample(logits, row_keys, steps):
+            filtered = filter_logits(
+                logits.astype(jnp.float32) / temperature, top_k, top_p)
+            keys = jax.vmap(jax.random.fold_in)(row_keys, steps)
+            return jax.vmap(jax.random.categorical)(
+                keys, filtered).astype(jnp.int32)
+    return sample
+
+
 def _make_stop_check(stop_tokens: tuple):
     if not stop_tokens:
         return lambda tok: jnp.zeros(tok.shape, bool)
@@ -579,6 +597,82 @@ def _decode_step(params: dict, tok: jax.Array, pos: jax.Array, slot,
     return logits[:, 0], new_caches
 
 
+def _row_write(cache: jax.Array, update: jax.Array,
+               slots: jax.Array) -> jax.Array:
+    """Write one new entry per row at a PER-ROW slot: vmap of the
+    single-row dynamic_update_slice over the batch axis.  `cache`
+    (B, W, ...), `update` (B, 1, ...), `slots` (B,) int32.  The serving
+    engine's continuous batch needs this — joined rows sit at different
+    decode offsets, so the uniform shared-slot write of `_decode_block`
+    no longer applies.  dynamic_update_slice clamps starts, so a frozen
+    row whose slot has run past the window writes harmlessly into its own
+    last slot (its `done` mask keeps the output frozen regardless)."""
+    zeros = (0,) * (cache.ndim - 2)
+    return jax.vmap(
+        lambda c, u, s: lax.dynamic_update_slice(c, u, (s,) + zeros)
+    )(cache, update, slots)
+
+
+def _decode_block_rows(module, bp: dict, x: jax.Array, cache: tuple,
+                       slots, visible, dtype, cache_kind: str):
+    """`_decode_block` with PER-ROW write slots (serving engine): row r
+    writes its K/V at `slots[r]` instead of one shared slot.  Math and
+    cache layouts are identical otherwise — same quantize-on-write int8
+    discipline, same `single_query_attention` read."""
+    from mmlspark_tpu.ops.attention import single_query_attention
+    n_heads = module.n_heads
+    b, s, d = x.shape
+    dh = d // n_heads
+    h = _ln(bp["LayerNorm_0"], x, dtype)
+    qkv = _dense(bp["qkv"], h, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, 1, n_heads, dh)
+    q, k, v = (t.reshape(shape) for t in (q, k, v))
+    if cache_kind == "int8":
+        from mmlspark_tpu.quant.quantize import quantize_kv
+        kq, ks, vq, vs = cache
+        k8, k8s = quantize_kv(k)
+        v8, v8s = quantize_kv(v)
+        kq = _row_write(kq, k8, slots)
+        ks = _row_write(ks, k8s, slots)
+        vq = _row_write(vq, v8, slots)
+        vs = _row_write(vs, v8s, slots)
+        o = single_query_attention(q[:, 0], kq, vq, visible,
+                                   k_scale=ks, v_scale=vs)
+        cache = (kq, ks, vq, vs)
+    else:
+        k_cache, v_cache = cache
+        k_cache = _row_write(k_cache, k.astype(k_cache.dtype), slots)
+        v_cache = _row_write(v_cache, v.astype(v_cache.dtype), slots)
+        o = single_query_attention(q[:, 0], k_cache, v_cache, visible)
+        cache = (k_cache, v_cache)
+    x = x + _dense(bp["proj"], o.reshape(b, 1, d).astype(dtype), dtype)
+    h2 = _ln(bp["LayerNorm_1"], x, dtype)
+    return x + _mlp(module, bp, h2, dtype), cache
+
+
+def _decode_step_rows(params: dict, tok: jax.Array, pos: jax.Array, slots,
+                      caches: list, visible, module,
+                      cache_kind: str = "model"):
+    """`_decode_step` with per-row write `slots` (B,) — the continuous-
+    batching decode step.  `pos` stays per-row true positions; callers
+    clamp it below max_len for frozen rows (their output is masked by
+    `done` anyway, but the position gather must stay in range)."""
+    dtype = module.dtype
+    emb = (params["tok_embed"]["embedding"][tok]
+           + params["pos_embed"]["embedding"][pos])
+    x = emb[:, None].astype(dtype)
+    new_caches = []
+    for i in range(module.n_layers):
+        x, cache = _decode_block_rows(module, params[f"block{i}_w"], x,
+                                      caches[i], slots, visible, dtype,
+                                      cache_kind)
+        new_caches.append(cache)
+    x = _ln(params["final_norm_w"], x, dtype)
+    logits = _dense(params["lm_head"], x, dtype).astype(jnp.float32)
+    return logits[:, 0], new_caches
+
+
 def _grow_cache(cache: jax.Array, window: int) -> jax.Array:
     """Zero-extend a cache prefix to `window` slots (static shapes).
     Rank-agnostic over the trailing axes: the (B, W, H, D) payloads and
@@ -588,6 +682,17 @@ def _grow_cache(cache: jax.Array, window: int) -> jax.Array:
         return cache
     pad = [(0, 0), (0, window - w_in)] + [(0, 0)] * (cache.ndim - 2)
     return jnp.pad(cache, pad)
+
+
+@jax.jit
+def _merge_cache_rows_jit(dst_caches, src_caches, di, si):
+    window = max(dst_caches[0][0].shape[1], src_caches[0][0].shape[1])
+    merged = []
+    for dst_layer, src_layer in zip(dst_caches, src_caches):
+        merged.append(tuple(
+            _grow_cache(d, window).at[di].set(_grow_cache(s, window)[si])
+            for d, s in zip(dst_layer, src_layer)))
+    return merged
 
 
 class DecodeEngine:
@@ -708,8 +813,51 @@ class DecodeEngine:
                 step, (tok, done, caches), jnp.arange(seg_len))
             return caches, toks.transpose(1, 0), tok, done
 
+        row_sample = _make_row_sampler(temperature,
+                                       None if greedy else top_k,
+                                       None if greedy else top_p)
+
+        def serve_segment_impl(seg_len, window, variables, caches, tok,
+                               done, true_len, budget, bucket, t_row,
+                               row_keys):
+            """The continuous-batching decode segment (serve/engine.py):
+            rows carry PER-ROW step offsets `t_row` (joined rows start at
+            0 while resident rows are mid-generation) and per-row token
+            budgets, so one compiled program advances a mixed-age batch
+            `seg_len` steps.  Rows freeze on stop/budget/done exactly as
+            the uniform-step segment; frozen rows' writes land in their
+            own cache row only and their emissions repeat the frozen
+            token (the engine's per-row emit counters ignore them)."""
+            params = variables["params"]
+            caches = [tuple(_grow_cache(c, window) for c in layer)
+                      for layer in caches]
+            slots_axis = jnp.arange(window)
+            max_pos = module.max_len - 1
+
+            def step(carry, s_off):
+                tok, done, caches = carry
+                t = t_row + s_off                     # (B,) per-row step
+                slot = jnp.minimum(bucket + t, window - 1)
+                pos = jnp.minimum(true_len + t, max_pos)
+                visible = ((slots_axis[None, :] < true_len[:, None])
+                           | ((slots_axis[None, :] >= bucket)
+                              & (slots_axis[None, :] <= slot[:, None])))
+                logits, caches = _decode_step_rows(
+                    params, tok, pos, slot, caches, visible, module,
+                    cache_dtype)
+                nxt = row_sample(logits, row_keys, t + 1)
+                nxt = jnp.where(done, tok, nxt)
+                done = done | is_stop(nxt) | (t + 1 >= budget)
+                return (nxt, done, caches), nxt
+
+            (tok, done, caches), toks = lax.scan(
+                step, (tok, done, caches), jnp.arange(seg_len))
+            return caches, toks.transpose(1, 0), tok, done
+
         self._prefill = jax.jit(prefill_impl)
         self._segment = jax.jit(segment_impl, static_argnums=(0, 1))
+        self._serve_segment = jax.jit(serve_segment_impl,
+                                      static_argnums=(0, 1))
         self._programs: set = set()
         self._program_costs: dict = {}  # program key -> captured cost row
         # (captured once at the recompile; replayed into every later
@@ -720,6 +868,74 @@ class DecodeEngine:
     def bucket_for(self, prompt_len: int) -> int:
         return bucket_length(prompt_len, self.module.max_len,
                              self.max_new_tokens, self.min_bucket)
+
+    # -- serving hooks (serve/engine.py) ---------------------------------
+    # The continuous-batching scheduler drives the engine's compiled
+    # programs directly at segment granularity: prefill a join cohort,
+    # splice its cache rows into the resident batch, advance everyone one
+    # mixed-age segment, cancel/harvest at the boundary.  All three hooks
+    # keep the jit shape-class discipline (and the recompile telemetry)
+    # of the batch path.
+
+    def serve_prefill(self, variables, prompts, true_len, live, row_keys):
+        """Prefill one join cohort: prompts (N, bucket) right-padded,
+        per-row true lengths, `live=False` born-done pad rows, per-row
+        sampling keys.  Returns (tok, done, caches) — the cohort's first
+        generated token per row and its bucket-window caches, ready to
+        splice into a resident batch with `merge_cache_rows`."""
+        b, p = prompts.shape
+        key = ("prefill", b, p)
+        tok, done, caches = self._prefill(
+            variables, jnp.asarray(prompts), jnp.asarray(true_len),
+            jnp.asarray(live), row_keys)
+        self._program(*key)
+        return tok, done, caches
+
+    def serve_step(self, variables, caches, tok, done, true_len, budget,
+                   bucket: int, t_row, row_keys, seg_len: int,
+                   window: int):
+        """Advance a mixed-age resident batch `seg_len` decode steps
+        (models/generate.py serve_segment_impl): per-row step offsets
+        `t_row` and per-row token budgets; returns (caches, toks
+        (B, seg_len), tok, done).  `window` must cover the highest slot
+        any live row writes: bucket + max(t_row) + seg_len, chunk-rounded
+        (`serve_window`)."""
+        b = int(tok.shape[0])
+        w_in = int(caches[0][0].shape[1])
+        # a resident cache never shrinks: joins after long-running rows
+        # completed can ask for a smaller cover than the batch already
+        # holds — the segment then just attends the existing width
+        window = max(int(window), w_in)
+        key = ("serve_segment", b, w_in, window, seg_len)
+        out = self._serve_segment(
+            seg_len, window, variables, caches, tok, done,
+            jnp.asarray(true_len), jnp.asarray(budget, jnp.int32),
+            jnp.asarray(bucket, jnp.int32),
+            jnp.asarray(t_row, jnp.int32), row_keys)
+        self._program(*key)
+        return out
+
+    def serve_window(self, bucket: int, max_t: int, seg_len: int) -> int:
+        """The chunk-rounded cache window covering a segment whose oldest
+        live row sits at step `max_t`, capped at the model's position
+        budget (frozen rows past the cap clamp their writes in-window)."""
+        need = min(bucket + max_t + seg_len, self.module.max_len)
+        return _round_up(max(need, bucket + 1), self.chunk)
+
+    @staticmethod
+    def merge_cache_rows(dst_caches, src_caches, dst_rows, src_rows):
+        """Splice cohort cache rows into a resident batch: row
+        `src_rows[i]` of `src_caches` replaces row `dst_rows[i]` of
+        `dst_caches`.  Both sides are grown to the wider window first
+        (zero-pad, `_grow_cache`), so a freshly prefilled cohort joins a
+        long-running batch without recompiling anything.  Works for both
+        cache layouts (2-tuple model-dtype, 4-tuple int8): every leaf is
+        row-indexed on axis 0.  One jitted program per (windows, rows)
+        shape class — a join is a handful of fused scatters, not a
+        cascade of eager ops."""
+        di = jnp.asarray(dst_rows, jnp.int32)
+        si = jnp.asarray(src_rows, jnp.int32)
+        return _merge_cache_rows_jit(dst_caches, src_caches, di, si)
 
     @property
     def compiled_programs(self) -> int:

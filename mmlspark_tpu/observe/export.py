@@ -69,6 +69,36 @@ def prometheus_text(run: Optional[RunTelemetry] = None) -> str:
         lines.append(f"# TYPE {metric} counter")
         lines.append(f"{metric} {_fmt(counters[name])}")
 
+    # circuit-breaker state per endpoint (resilience/breaker.py): live
+    # process-wide snapshots like the counters, so a tripped breaker is
+    # visible to a scraper whether or not a run block is active.
+    # state codes: 0 closed, 1 half-open, 2 open (breaker.STATE_CODES)
+    from mmlspark_tpu.resilience.breaker import breakers_snapshot
+    breakers = breakers_snapshot()
+    if breakers:
+        state = _metric_name("breaker_state")
+        retry = _metric_name("breaker_retry_in_s")
+        fails = _metric_name("breaker_consecutive_failures")
+        lines.append(f"# HELP {state} circuit-breaker state per endpoint "
+                     f"(0=closed, 1=half-open, 2=open)")
+        lines.append(f"# TYPE {state} gauge")
+        for ep in sorted(breakers):
+            lines.append(f'{state}{{endpoint="{_label_value(ep)}"}} '
+                         f"{_fmt(breakers[ep]['state_code'])}")
+        lines.append(f"# HELP {retry} seconds until the endpoint's next "
+                     f"half-open probe is allowed (0 when closed/due)")
+        lines.append(f"# TYPE {retry} gauge")
+        for ep in sorted(breakers):
+            lines.append(f'{retry}{{endpoint="{_label_value(ep)}"}} '
+                         f"{_fmt(breakers[ep]['retry_in_s'])}")
+        lines.append(f"# HELP {fails} consecutive failures recorded "
+                     f"against the endpoint")
+        lines.append(f"# TYPE {fails} gauge")
+        for ep in sorted(breakers):
+            lines.append(
+                f'{fails}{{endpoint="{_label_value(ep)}"}} '
+                f"{_fmt(breakers[ep]['consecutive_failures'])}")
+
     if run is not None and run.live:
         for name, g in sorted(run.gauges().items()):
             metric = _metric_name(name)
@@ -150,24 +180,60 @@ def write_metrics(path: str, run: Optional[RunTelemetry] = None) -> str:
     return path
 
 
+def stop_server(server, timeout_s: float = 2.0) -> bool:
+    """Stop an HTTP server started here within a bounded time.
+
+    `HTTPServer.shutdown()` blocks until the serve_forever loop notices —
+    normally milliseconds, but a wedged handler (a hung client mid-write)
+    can hold it arbitrarily; this calls it from a reaper thread and waits
+    at most `timeout_s` before closing the listening socket regardless,
+    so a telemetry exit (or a graceful drain) is never held hostage by
+    one stuck connection.  Returns True when the loop confirmed shutdown
+    inside the budget."""
+    stopper = threading.Thread(target=server.shutdown, daemon=True,
+                               name="mmlspark-metrics-stop")
+    stopper.start()
+    stopper.join(timeout_s)
+    clean = not stopper.is_alive()
+    if not clean:
+        from mmlspark_tpu.observe.logging import get_logger
+        get_logger("observe.export").warning(
+            "metrics server did not confirm shutdown within %.1fs; "
+            "closing its socket anyway", timeout_s)
+    server.server_close()
+    return clean
+
+
 def serve_metrics(port: int = 0, host: str = "127.0.0.1",
                   run: Optional[RunTelemetry] = None):
     """Serve GET /metrics on a daemon thread (stdlib http.server only).
 
     `run` is captured HERE, on the caller's thread: the server thread
     never sees the caller's contextvars (the same capture-by-closure rule
-    as spans.py), so the ambient run must be bound at call time.
+    as spans.py), so the ambient run must be bound at call time.  When a
+    live run is bound, the server registers a run finalizer so the
+    run_telemetry exit stops it with `stop_server`'s bounded wait —
+    a run block never leaks its scrape port.
+
+    Unknown paths get a 404 and errors carry an explicit text/plain
+    Content-Type (BaseHTTPRequestHandler's default error page is HTML —
+    wrong for a metrics port whose only consumers speak plain text).
     Returns the HTTPServer; port 0 binds an ephemeral port (read it back
-    from `server.server_address[1]`), `server.shutdown()` stops it.
+    from `server.server_address[1]`), `stop_server(server)` (or
+    `server.shutdown()`) stops it.
     """
     import http.server
 
     run = run if run is not None else active_run()
 
     class Handler(http.server.BaseHTTPRequestHandler):
+        # explicit Content-Type on every error response (404s included)
+        error_content_type = "text/plain; charset=utf-8"
+        error_message_format = "%(code)d %(message)s\n"
+
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
             if self.path.split("?")[0] not in ("/metrics", "/"):
-                self.send_error(404)
+                self.send_error(404, "unknown path (try /metrics)")
                 return
             body = prometheus_text(run).encode()
             self.send_response(200)
@@ -185,4 +251,6 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1",
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name="mmlspark-metrics")
     thread.start()
+    if run is not None and run.live:
+        run.add_finalizer(lambda: stop_server(server))
     return server

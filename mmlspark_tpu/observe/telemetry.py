@@ -92,6 +92,14 @@ class RunTelemetry:
         # dict per supervisor event (failure / recover / completed /
         # gave_up ...), surfaced machine-readable in run_summary.json
         self._recovery: list[dict] = []
+        # the run's serving timeline (serve/engine.py): admission, shed,
+        # degrade, drain decisions in order — the machine-readable account
+        # the serve drills assert against
+        self._serve: list[dict] = []
+        # bounded-time cleanups run at finish() (e.g. stopping a metrics
+        # server bound to this run) — never allowed to raise or hang the
+        # run exit
+        self._finalizers: list = []
         self._t0 = time.perf_counter()
         self._finished: Optional[dict] = None
         if live:
@@ -198,6 +206,27 @@ class RunTelemetry:
         self.tracer._record({"type": "recovery",
                              "ts": round(self.tracer.now(), 6), **rec})
 
+    # -- serving timeline --------------------------------------------------
+    def record_serve(self, event: dict) -> None:
+        """Append one serving-engine lifecycle event to the run's ordered
+        timeline (also streamed as a `serve` record); the full list lands
+        in run_summary.json under `serve` — what the serve chaos drills
+        assert their shed/degrade/drain sequences against."""
+        if not self.live:
+            return
+        rec = dict(event)
+        self._serve.append(rec)
+        self.tracer._record({"type": "serve",
+                             "ts": round(self.tracer.now(), 6), **rec})
+
+    # -- finalizers --------------------------------------------------------
+    def add_finalizer(self, fn) -> None:
+        """Register a cleanup to run at `finish()` (LIFO).  Finalizers
+        must themselves be bounded-time (observe/export.py's server stop
+        is); a raising finalizer is swallowed — run exit always
+        completes."""
+        self._finalizers.append(fn)
+
     # -- counters ---------------------------------------------------------
     def counter_deltas(self) -> dict[str, float]:
         """Counter movement since the block was entered (only counters
@@ -224,6 +253,7 @@ class RunTelemetry:
             "stage_timings": self.timings.summary(),
             "programs": self.program_summary(),
             "recovery": [dict(e) for e in self._recovery],
+            "serve": [dict(e) for e in self._serve],
             "trace_records_dropped": self.tracer.dropped,
         }
 
@@ -232,6 +262,15 @@ class RunTelemetry:
         deltas, stage attribution, run_end), run_summary.json, sink close."""
         if self._finished is not None:
             return self._finished
+        while self._finalizers:
+            fn = self._finalizers.pop()
+            try:
+                fn()
+            except Exception:  # run exit always completes
+                from mmlspark_tpu.observe.logging import get_logger
+                get_logger("observe").warning(
+                    "run finalizer %r raised; continuing run exit", fn,
+                    exc_info=True)
         if not self.live:
             self._finished = {}
             return self._finished

@@ -22,7 +22,9 @@ as first-class, individually testable pieces:
 See docs/resilience.md for the operator-facing knobs.
 """
 
-from mmlspark_tpu.resilience.breaker import (CircuitBreaker, CircuitOpenError,
+from mmlspark_tpu.resilience.breaker import (CircuitBreaker,
+                                             CircuitOpenError,
+                                             breakers_snapshot,
                                              get_breaker, reset_breakers)
 from mmlspark_tpu.resilience.chaos import (ChaosInjector, Fault,
                                            InjectedNetworkError,
@@ -46,7 +48,8 @@ from mmlspark_tpu.resilience.retry import (RetryBudgetExceeded, RetryPolicy,
                                            retryable_status)
 
 __all__ = [
-    "CircuitBreaker", "CircuitOpenError", "get_breaker", "reset_breakers",
+    "CircuitBreaker", "CircuitOpenError", "breakers_snapshot",
+    "get_breaker", "reset_breakers",
     "ChaosInjector", "Fault", "InjectedNetworkError", "InjectedStallError",
     "Scenario", "get_injector", "reset_chaos", "run_scenario",
     "set_injector",

@@ -37,6 +37,10 @@ BREAKER_RESET_S = config.register(
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
+# numeric form of the state for gauges (Prometheus samples are floats):
+# 0 = closed (healthy), 1 = half-open (probing), 2 = open (shedding)
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
 
 class CircuitOpenError(ConnectionError):
     """Refused without calling: the endpoint's circuit is open."""
@@ -81,6 +85,37 @@ class CircuitBreaker:
     def _now(self) -> float:
         return (self._clock or get_clock()).monotonic()
 
+    def retry_in_s(self) -> float:
+        """Seconds until the next half-open probe would be allowed (0 when
+        closed or already due) — the time-to-retry the state gauges and
+        `Retry-After` surfaces read."""
+        with self._lock:
+            if self.state != OPEN:
+                return 0.0
+            return max(0.0, self.reset_s - (self._now() - self._opened_at))
+
+    def snapshot(self) -> dict:
+        """Point-in-time state for the observability exports: state name +
+        numeric code, consecutive failures, and time-to-retry."""
+        retry = self.retry_in_s()
+        with self._lock:
+            return {"state": self.state,
+                    "state_code": STATE_CODES[self.state],
+                    "consecutive_failures": self.consecutive_failures,
+                    "retry_in_s": round(retry, 3)}
+
+    def _gauge_state(self) -> None:
+        """Record the breaker's state as run gauges (call on transitions,
+        holding no lock): `breaker.<endpoint>.state` makes a trip visible
+        in run_summary.json and Prometheus, not just as an event."""
+        from mmlspark_tpu.observe.telemetry import active_run
+        run = active_run()
+        if run is not None:
+            run.gauge(f"breaker.{self.endpoint}.state",
+                      STATE_CODES[self.state])
+            run.gauge(f"breaker.{self.endpoint}.retry_in_s",
+                      self.retry_in_s())
+
     def allow(self) -> None:
         """Gate one attempt: no-op when closed, raises when open, lets a
         single probe through once the cooldown has elapsed."""
@@ -99,23 +134,27 @@ class CircuitBreaker:
                 get_logger("resilience").info(
                     "breaker %s: half-open probe after %.1fs",
                     self.endpoint, waited)
-                return  # this caller IS the probe
-            if self.state == HALF_OPEN:
+            elif self.state == HALF_OPEN:
                 # a probe is already in flight; refuse concurrent callers
                 # (they would defeat the single-probe semantics)
                 inc_counter("breaker.refused")
                 trace_event("breaker.refused", cat="resilience",
                             endpoint=self.endpoint, state=HALF_OPEN)
                 raise CircuitOpenError(self.endpoint, self.reset_s)
-            inc_counter("breaker.refused")
-            trace_event("breaker.refused", cat="resilience",
-                        endpoint=self.endpoint, state=OPEN)
-            raise CircuitOpenError(self.endpoint,
-                                   self.reset_s - waited)
+            else:
+                inc_counter("breaker.refused")
+                trace_event("breaker.refused", cat="resilience",
+                            endpoint=self.endpoint, state=OPEN)
+                raise CircuitOpenError(self.endpoint,
+                                       self.reset_s - waited)
+        # gauges outside the lock (they re-read state via retry_in_s)
+        self._gauge_state()
+        return  # this caller IS the probe
 
     def record_success(self) -> None:
         with self._lock:
-            if self.state != CLOSED:
+            changed = self.state != CLOSED
+            if changed:
                 inc_counter("breaker.closed")
                 trace_event("breaker.closed", cat="resilience",
                             endpoint=self.endpoint, outcome="probe_ok")
@@ -124,6 +163,8 @@ class CircuitBreaker:
                     self.endpoint)
             self.state = CLOSED
             self.consecutive_failures = 0
+        if changed:
+            self._gauge_state()
 
     def record_failure(self, exc: Optional[BaseException] = None) -> None:
         if self.threshold <= 0:
@@ -134,7 +175,8 @@ class CircuitBreaker:
             self.consecutive_failures += 1
             trip = (self.state == HALF_OPEN
                     or self.consecutive_failures >= self.threshold)
-            if trip and self.state != OPEN:
+            opened = trip and self.state != OPEN
+            if opened:
                 self.state = OPEN
                 self._opened_at = self._now()
                 inc_counter("breaker.opened")
@@ -148,6 +190,8 @@ class CircuitBreaker:
                     self.consecutive_failures, exc, self.reset_s)
             elif trip:
                 self._opened_at = self._now()  # failed probe: restart cooldown
+        if opened:
+            self._gauge_state()
 
 
 _breakers: dict[str, CircuitBreaker] = {}
@@ -161,6 +205,15 @@ def get_breaker(endpoint: str) -> CircuitBreaker:
         if breaker is None:
             breaker = _breakers[endpoint] = CircuitBreaker(endpoint)
         return breaker
+
+
+def breakers_snapshot() -> dict[str, dict]:
+    """Every registered breaker's `snapshot()` by endpoint — the pull
+    surface observe/export.py renders as per-endpoint Prometheus gauges
+    (`mmlspark_tpu_breaker_state{endpoint=...}` etc.)."""
+    with _registry_lock:
+        breakers = list(_breakers.items())
+    return {endpoint: b.snapshot() for endpoint, b in breakers}
 
 
 def reset_breakers() -> None:

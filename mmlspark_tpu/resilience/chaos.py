@@ -109,17 +109,33 @@ class InjectedStallError(TimeoutError):
 class Fault:
     """One scripted fault in a chaos scenario.
 
-    kind: 'nan' | 'sigterm' | 'hang' (fire once when training reaches
-    `step`) or 'tear' (corrupt `target` on the `at_write`-th rotation).
+    Training faults: 'nan' | 'sigterm' | 'hang' (fire once when training
+    reaches `step`) or 'tear' (corrupt `target` on the `at_write`-th
+    rotation).
+
+    Serving faults (interpreted by the serve drill's workload driver,
+    scripts/serve_drill.py, against serve/engine.py): 'burst' (inject
+    `size` extra back-to-back arrivals when the workload reaches request
+    index `at_request`), 'slow_client' (the at_request-th HTTP client
+    connects, sends a partial request, then stalls `seconds` — the engine
+    must keep serving everyone else), 'poison' (the at_request-th request
+    is malformed — out-of-vocabulary tokens / impossible budget — and
+    must be rejected without corrupting any neighbor).  Mid-flight
+    SIGTERM drills reuse kind 'sigterm': the driver feeds request indices
+    to `on_step`, so `step` doubles as a request index there.
     """
 
     kind: str
     step: int = 0            # nan / sigterm / hang trigger step
-    seconds: float = 0.5     # hang duration (REAL seconds)
+    seconds: float = 0.5     # hang / slow-client stall duration (REAL s)
     at_write: int = 1        # tear: which checkpoint write (1-based)
     target: str = "payload"  # tear: payload | sidecar | latest
+    at_request: int = 1      # serving faults: workload request index (1-based)
+    size: int = 8            # burst: how many extra arrivals to inject
 
-    _KINDS = ("nan", "sigterm", "hang", "tear")
+    _KINDS = ("nan", "sigterm", "hang", "tear",
+              "burst", "slow_client", "poison")
+    _SERVE_KINDS = ("burst", "slow_client", "poison")
     _TARGETS = ("payload", "sidecar", "latest")
 
     def __post_init__(self):
@@ -287,6 +303,26 @@ class ChaosInjector:
             "chaos: hanging step %d for %.2fs (real time)", step, hang_s)
         time.sleep(hang_s)  # REAL seconds: the watchdog deadline is wall
         return True
+
+    # -- serving hazards ---------------------------------------------------
+    def serve_faults_due(self, request_index: int) -> list:
+        """The unfired scripted serving faults due at `request_index`
+        (1-based workload position), each fired at most once.  The serve
+        drill's workload driver consults this before issuing each request
+        and acts the fault out — a burst enqueues `size` extra arrivals,
+        a slow client stalls its connection, a poison request goes out
+        malformed.  The engine under test never sees this hook; only the
+        traffic it produces."""
+        due = []
+        for i, f in enumerate(self.script):
+            if f.kind in Fault._SERVE_KINDS and i not in self._fired \
+                    and request_index >= f.at_request:
+                self._fired.add(i)
+                inc_counter(f"chaos.serve_{f.kind}")
+                trace_event(f"chaos.serve_{f.kind}", cat="resilience",
+                            request_index=request_index)
+                due.append(f)
+        return due
 
     # -- numerics hazards --------------------------------------------------
     def poison_nan(self, step: int) -> bool:

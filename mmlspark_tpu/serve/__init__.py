@@ -1,0 +1,36 @@
+"""Resilient online serving over the decode engine.
+
+The offline entry points (`transform(table)`) assume the whole workload
+is in hand; serving inverts every premise — requests arrive when they
+arrive, carry deadlines, and overload is the steady state, not the
+exception.  This package is the robustness-first serving runtime the
+ROADMAP's "millions of users" north star needs:
+
+  * `admission` — bounded queue + deadline-feasibility admission control
+    and the deadline-miss-rate breaker (shed at the front door, not by
+    timing out in the back);
+  * `engine` — the continuous-batching scheduler over `DecodeEngine`'s
+    serve hooks (join at segment boundaries, cancel expired rows,
+    degraded-mode failover to a quantized bundle);
+  * `lifecycle` — warmup/readiness, the loop + HTTP threads (the ONE
+    module allowed to spawn them — scripts/lint.py), SIGTERM -> graceful
+    drain;
+  * `http` — stdlib-only request front end + health endpoints
+    (`/healthz`, `/readyz`, POST `/generate`), next to
+    `observe/export.serve_metrics`.
+
+docs/serving.md has the request lifecycle, policies, and knobs.
+"""
+
+from mmlspark_tpu.serve.admission import (AdmissionController,
+                                          InvalidRequest, MissRateBreaker,
+                                          Overloaded, StepTimeEstimator)
+from mmlspark_tpu.serve.engine import ServeConfig, ServingEngine
+from mmlspark_tpu.serve.lifecycle import serve_forever, start_engine, start_http
+from mmlspark_tpu.serve.request import Request
+
+__all__ = [
+    "AdmissionController", "InvalidRequest", "MissRateBreaker",
+    "Overloaded", "Request", "ServeConfig", "ServingEngine",
+    "StepTimeEstimator", "serve_forever", "start_engine", "start_http",
+]

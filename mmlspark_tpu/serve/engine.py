@@ -1,0 +1,696 @@
+"""The continuous-batching scheduler: robustness under load as the
+design center.
+
+One scheduler owns a set of resident decode GROUPS — one per (prompt
+bucket, lane) — each a fixed-capacity batch driven through
+`DecodeEngine`'s serve hooks (models/generate.py).  The loop advances in
+SEGMENTS (`segment_steps` decode steps per compiled call) and makes every
+robustness decision at the segment boundary, the natural synchronization
+point the PR-3 engine already exposes:
+
+  * JOIN — queued requests prefill as a cohort (padded to a power of two,
+    so join batches reuse a handful of compiled shape classes) and their
+    cache rows splice into free slots of the running batch
+    (`merge_cache_rows`).  A short request that finishes frees its slot
+    for the next arrival while long rows keep decoding: occupancy
+    tracks offered load instead of draining to one.
+  * CANCEL — a resident row whose deadline has passed is frozen (its
+    `done` mask bit) and its request finished as `timeout`; the engine
+    never spends another decode step on work nobody can use.
+  * COMPLETE — rows that hit their token budget or stop token are
+    harvested and their slots freed.
+
+Overload never reaches this loop: admission (serve/admission.py) sheds at
+the front door on queue depth, deadline feasibility, and the
+deadline-miss breaker — and when the breaker is open with a quantized
+fallback bundle configured, new traffic runs DEGRADED on the int8
+weights (quant/) instead of being refused: reduced fidelity beats an
+error page.
+
+Every request carries a `serve.request` span; segments and prefills are
+span-timed and feed the admission controller's per-bucket EWMAs, so the
+feasibility math always reflects the engine as measured, not as hoped.
+Deadline math runs on the injectable resilience clock — the whole
+scheduler is testable with a `VirtualClock` and zero sleeps by calling
+`_tick()` directly (the loop thread, spawned by serve/lifecycle.py, is
+just `_tick` + a condition wait).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+from mmlspark_tpu import config
+from mmlspark_tpu.models.generate import (DEFAULT_CACHE_CHUNK, DecodeEngine,
+                                          _round_up)
+from mmlspark_tpu.observe.logging import get_logger
+from mmlspark_tpu.observe.metrics import inc_counter
+from mmlspark_tpu.observe.spans import monotonic
+from mmlspark_tpu.observe.telemetry import active_run
+from mmlspark_tpu.observe.trace import span_on_tracer, trace_event
+from mmlspark_tpu.resilience.clock import Clock, get_clock
+from mmlspark_tpu.serve.admission import (AdmissionController,
+                                          InvalidRequest, MissRateBreaker,
+                                          Overloaded, StepTimeEstimator)
+from mmlspark_tpu.serve.request import (CANCELLED, OK, TIMEOUT, Request)
+
+SERVE_QUEUE_CAPACITY = config.register(
+    "MMLSPARK_TPU_SERVE_QUEUE_CAPACITY", 64,
+    "serving: bounded admission-queue depth; arrivals beyond it shed "
+    "with Overloaded (429)", ptype=int)
+SERVE_MAX_BATCH = config.register(
+    "MMLSPARK_TPU_SERVE_MAX_BATCH", 8,
+    "serving: resident decode slots per prompt-bucket group (the "
+    "continuous batch width)", ptype=int)
+SERVE_SEGMENT_STEPS = config.register(
+    "MMLSPARK_TPU_SERVE_SEGMENT_STEPS", 8,
+    "serving: decode steps per compiled segment — the join/cancel/"
+    "complete boundary cadence", ptype=int)
+SERVE_DEFAULT_DEADLINE_S = config.register(
+    "MMLSPARK_TPU_SERVE_DEFAULT_DEADLINE_S", 30.0,
+    "serving: deadline for requests that do not set one", ptype=float)
+SERVE_DRAIN_TIMEOUT_S = config.register(
+    "MMLSPARK_TPU_SERVE_DRAIN_TIMEOUT_S", 10.0,
+    "serving: graceful-drain budget after SIGTERM/stop — in-flight "
+    "requests finish or cancel by min(their deadline, this), then the "
+    "loop exits", ptype=float)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Knobs for one ServingEngine (docs/serving.md 'Knobs').
+
+    None fields fall back to their MMLSPARK_TPU_SERVE_* config vars at
+    construction, the TrainerConfig convention."""
+
+    max_new_tokens: int = 32          # engine-wide generation cap
+    max_batch: Optional[int] = None   # resident slots per bucket group
+    queue_capacity: Optional[int] = None
+    segment_steps: Optional[int] = None
+    default_deadline_s: Optional[float] = None
+    drain_timeout_s: Optional[float] = None
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    stop_tokens: tuple = ()
+    cache_chunk: int = DEFAULT_CACHE_CHUNK
+    seed: int = 0
+    # deadline-miss breaker (serve/admission.py MissRateBreaker)
+    miss_window: int = 32
+    miss_min_samples: int = 8
+    shed_miss_rate: float = 0.5
+    breaker_reset_s: float = 5.0
+    warmup_buckets: tuple = ()        # () = the engine's smallest bucket
+
+    def __post_init__(self):
+        read = lambda explicit, var, cast: cast(
+            var.current() if explicit is None else explicit)
+        self.max_batch = read(self.max_batch, SERVE_MAX_BATCH, int)
+        self.queue_capacity = read(self.queue_capacity,
+                                   SERVE_QUEUE_CAPACITY, int)
+        self.segment_steps = read(self.segment_steps,
+                                  SERVE_SEGMENT_STEPS, int)
+        self.default_deadline_s = read(self.default_deadline_s,
+                                       SERVE_DEFAULT_DEADLINE_S, float)
+        self.drain_timeout_s = read(self.drain_timeout_s,
+                                    SERVE_DRAIN_TIMEOUT_S, float)
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.segment_steps < 1:
+            raise ValueError("segment_steps must be >= 1")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+class _Group:
+    """One (bucket, lane)'s resident batch: fixed `capacity` rows, numpy
+    row state on the host, caches on the device.  A row is free when
+    `rows[i] is None` (its `done` bit stays True so the compiled segment
+    freezes it)."""
+
+    def __init__(self, bucket: int, capacity: int):
+        self.bucket = bucket
+        self.capacity = capacity
+        self.rows: list[Optional[Request]] = [None] * capacity
+        self.caches = None
+        self.tok = np.zeros(capacity, np.int32)
+        self.done = np.ones(capacity, bool)
+        self.true_len = np.ones(capacity, np.int32)
+        self.budget = np.zeros(capacity, np.int32)
+        self.t_row = np.zeros(capacity, np.int32)
+        self.row_ids = np.zeros(capacity, np.int32)
+        # per-row sampling keys, cached until the row composition changes
+        # (recomputing the fold every segment would retrace a vmap per
+        # tick for nothing)
+        self.keys = None
+        self.keys_ids: Optional[tuple] = None
+
+    def free_slots(self) -> list:
+        return [i for i, r in enumerate(self.rows) if r is None]
+
+    def live_slots(self) -> list:
+        return [i for i, r in enumerate(self.rows) if r is not None]
+
+    def release(self, slot: int) -> None:
+        self.rows[slot] = None
+        self.done[slot] = True
+        self.t_row[slot] = 0
+        self.budget[slot] = 0
+        self.true_len[slot] = 1
+
+
+# engine lifecycle states
+CREATED, READY, DRAINING, STOPPED = "created", "ready", "draining", "stopped"
+
+
+class ServingEngine:
+    """In-process serving over a model bundle (module docstring).
+
+    Inline (tests, benches): construct, `warmup()`, then call `submit` +
+    `_tick()` yourself — with an injected `VirtualClock` nothing sleeps.
+    Production: `serve/lifecycle.start_engine(engine)` spawns the loop
+    thread and wires SIGTERM -> `begin_drain`; `serve/lifecycle.
+    start_http` puts the stdlib front end in front of `submit`.
+    """
+
+    def __init__(self, bundle, cfg: Optional[ServeConfig] = None, *,
+                 degraded_bundle=None, clock: Optional[Clock] = None):
+        self.cfg = cfg or ServeConfig()
+        self._clock = clock
+        self._bundle = bundle
+        self._module = bundle.module()
+        self._engines = {"primary": self._decode_engine(self._module)}
+        self._variables = {"primary": bundle.variables}
+        if degraded_bundle is not None:
+            deg = degraded_bundle.module()
+            if deg.vocab_size != self._module.vocab_size:
+                raise ValueError(
+                    "degraded bundle must share the primary vocabulary")
+            self._engines["degraded"] = self._decode_engine(deg)
+            self._variables["degraded"] = degraded_bundle.variables
+        self.estimator = StepTimeEstimator()
+        self.breaker = MissRateBreaker(
+            "serve", window=self.cfg.miss_window,
+            min_samples=self.cfg.miss_min_samples,
+            miss_rate=self.cfg.shed_miss_rate,
+            reset_s=self.cfg.breaker_reset_s, clock=clock)
+        self.admission = AdmissionController(
+            self.cfg.queue_capacity, self.estimator, self.breaker,
+            max_batch=self.cfg.max_batch,
+            degraded_available=degraded_bundle is not None, clock=clock)
+        self._groups: dict[tuple, _Group] = {}
+        self._state = CREATED
+        self._state_lock = threading.Lock()
+        self._wake = threading.Condition()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._latencies: list[float] = []
+        self._counts: dict[str, int] = {}
+        self._counts_lock = threading.Lock()
+        self._drain_deadline: Optional[float] = None
+        self._thread = None            # set by lifecycle.start_engine
+        self._guard = None             # PreemptionGuard, set by lifecycle
+        # telemetry handles captured ONCE, on the constructing thread
+        # (the loop thread never sees the caller's contextvars)
+        self._run = active_run()
+        self._tracer = self._run.tracer if self._run is not None else None
+        self._base_key = jax.random.key(self.cfg.seed)
+        # jitted so repeated folds (every join) don't re-trace the vmap;
+        # compiled once per cohort size
+        self._fold_keys = jax.jit(jax.vmap(
+            lambda i: jax.random.fold_in(self._base_key, i)))
+        self._stops = np.asarray(self.cfg.stop_tokens or (), np.int32)
+
+    def _decode_engine(self, module) -> DecodeEngine:
+        return DecodeEngine(
+            module, self.cfg.max_new_tokens,
+            temperature=self.cfg.temperature, top_k=self.cfg.top_k,
+            top_p=self.cfg.top_p, stop_tokens=self.cfg.stop_tokens,
+            chunk=self.cfg.cache_chunk)
+
+    # -- lifecycle ---------------------------------------------------------
+    def now(self) -> float:
+        return (self._clock or get_clock()).monotonic()
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def ready(self) -> bool:
+        return self._state == READY
+
+    @property
+    def alive(self) -> bool:
+        return self._state in (READY, DRAINING)
+
+    def warmup(self) -> "ServingEngine":
+        """Pre-compile the serving shape classes BEFORE readiness flips:
+        cohort prefills (each power-of-two join width up to capacity) and
+        one resident segment per warmup bucket.  A first real request
+        must never pay an XLA compile against its deadline."""
+        if self._state != CREATED:
+            return self
+        engine = self._engines["primary"]
+        buckets = tuple(self.cfg.warmup_buckets) or (engine.bucket_for(1),)
+        t0 = monotonic()
+        for lane, eng in self._engines.items():
+            variables = self._variables[lane]
+            for bucket in buckets:
+                self._warm_bucket(eng, variables, int(bucket))
+        self._record_serve({"event": "warmup_done",
+                            "buckets": list(map(int, buckets)),
+                            "seconds": round(monotonic() - t0, 3)})
+        self._state = READY
+        self._record_serve({"event": "ready"})
+        get_logger("serve").info(
+            "serving engine ready: buckets %s warmed in %.2fs",
+            list(buckets), monotonic() - t0)
+        return self
+
+    def _warm_bucket(self, eng: DecodeEngine, variables, bucket: int) -> None:
+        """Compile every shape class a full-budget batch in this bucket
+        can touch: cohort prefills at each power-of-two join width, then
+        a dummy capacity batch driven through the whole segment/window
+        ladder — so a ready engine never pays XLA against a deadline."""
+        cap = self.cfg.max_batch
+        seg = self.cfg.segment_steps
+        n = 1
+        while True:
+            m = min(n, cap)
+            prompts = np.zeros((m, bucket), np.int32)
+            live = np.ones(m, bool)
+            tl = np.ones(m, np.int32)
+            keys = self._row_keys(np.arange(m))
+            tok, done, caches = eng.serve_prefill(variables, prompts, tl,
+                                                  live, keys)
+            if n >= cap:
+                break
+            n *= 2
+        budget = np.full(cap, self.cfg.max_new_tokens, np.int32)
+        t_row = np.zeros(cap, np.int32)
+        t = 0
+        while t < self.cfg.max_new_tokens:
+            window = eng.serve_window(bucket, t, seg)
+            caches, _, tok, done = eng.serve_step(
+                variables, caches, tok, done, tl, budget, bucket, t_row,
+                keys, seg, window)
+            t += seg
+            t_row = t_row + seg
+
+    def begin_drain(self, reason: str = "stop") -> None:
+        """Stop admitting; in-flight requests finish or cancel by
+        min(their deadline, now + drain_timeout); then the loop exits.
+        Idempotent; safe from any thread (SIGTERM handler included)."""
+        with self._state_lock:
+            if self._state not in (CREATED, READY):
+                return
+            self._state = DRAINING
+            self._drain_deadline = self.now() + self.cfg.drain_timeout_s
+        self.admission.close()
+        inc_counter("serve.drains")
+        trace_event("serve.drain_start", cat="serve", reason=reason)
+        self._record_serve({"event": "drain_start", "reason": reason,
+                            "in_flight": self.in_flight(),
+                            "queued": self.admission.pending()})
+        get_logger("serve").warning(
+            "serving engine draining (%s): %d in flight, %d queued",
+            reason, self.in_flight(), self.admission.pending())
+        with self._wake:
+            self._wake.notify_all()
+
+    def _finish_drain(self) -> None:
+        self._state = STOPPED
+        trace_event("serve.drain_end", cat="serve")
+        self._record_serve({"event": "drain_end",
+                            "counts": dict(self._counts)})
+        self._gauge_stats()
+        with self._wake:
+            self._wake.notify_all()
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        """Graceful stop: drain, then join the loop thread (if any)."""
+        self.begin_drain("stop")
+        if self._thread is not None:
+            self._thread.join(timeout if timeout is not None
+                              else self.cfg.drain_timeout_s + 5.0)
+        else:
+            # inline engines drain synchronously (each tick makes
+            # progress: joins, decode, or the drain-deadline cancel)
+            while self._state == DRAINING:
+                if self._drained():
+                    self._finish_drain()
+                    break
+                self._tick()
+
+    # -- submission --------------------------------------------------------
+    def _new_id(self) -> int:
+        with self._id_lock:
+            self._next_id += 1
+            return self._next_id
+
+    def _validate(self, prompt, max_new_tokens: int) -> np.ndarray:
+        try:
+            arr = np.asarray(prompt, np.int32)
+        except (TypeError, ValueError) as e:
+            raise InvalidRequest(f"prompt is not a token array: {e}") from e
+        if arr.ndim != 1 or arr.size < 1:
+            raise InvalidRequest(
+                f"prompt must be a non-empty 1-D token array, got shape "
+                f"{arr.shape}")
+        if arr.min() < 0 or arr.max() >= self._module.vocab_size:
+            raise InvalidRequest(
+                f"prompt tokens outside the vocabulary "
+                f"[0, {self._module.vocab_size})")
+        if not 1 <= int(max_new_tokens) <= self.cfg.max_new_tokens:
+            raise InvalidRequest(
+                f"max_new_tokens must be in [1, {self.cfg.max_new_tokens}],"
+                f" got {max_new_tokens}")
+        return arr
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        """Admit one request or raise (`InvalidRequest` for poison,
+        `Overloaded` when shed).  Returns the live `Request`; callers
+        block on `request.wait()` or poll `request.finished`."""
+        if not self.alive:
+            self._count("shed_draining")
+            self._count("shed")
+            self._record_serve({"event": "shed", "reason": "draining"})
+            raise Overloaded("draining", 1.0,
+                             f"engine is {self._state}")
+        n_new = int(max_new_tokens if max_new_tokens is not None
+                    else self.cfg.max_new_tokens)
+        arr = self._validate(prompt, n_new)
+        try:
+            bucket = self._engines["primary"].bucket_for(arr.size)
+        except ValueError as e:
+            inc_counter("serve.poison")
+            raise InvalidRequest(str(e)) from e
+        now = self.now()
+        deadline = now + (float(deadline_s) if deadline_s is not None
+                          else self.cfg.default_deadline_s)
+        req = Request(self._new_id(), arr, bucket, n_new, now, deadline)
+        try:
+            self.admission.try_admit(req, self.in_flight_tokens())
+        except Overloaded as e:
+            self._count(f"shed_{e.reason}")
+            self._count("shed")
+            self._record_serve({"event": "shed", "reason": e.reason,
+                               "request": req.id})
+            raise
+        self._count("admitted")
+        if req.degraded:
+            self._count("degraded")
+            self._record_serve({"event": "degraded", "request": req.id})
+        if self._tracer is not None:
+            req.span = self._tracer.span(
+                "serve.request", cat="serve", request=req.id,
+                bucket=bucket, prompt_len=arr.size, new_tokens=n_new,
+                deadline_in_s=round(deadline - now, 4))
+        with self._wake:
+            self._wake.notify_all()
+        return req
+
+    # -- accounting --------------------------------------------------------
+    def _count(self, name: str, n: int = 1) -> None:
+        # front-end threads (submit) and the loop thread both count;
+        # the lock keeps read-modify-write updates from losing increments
+        with self._counts_lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def _record_serve(self, event: dict) -> None:
+        if self._run is not None:
+            self._run.record_serve(event)
+
+    def in_flight(self) -> int:
+        # list() the dict: submit threads read while the loop thread
+        # adds/drops groups (iterating the live dict would race)
+        return sum(len(g.live_slots()) for g in list(self._groups.values()))
+
+    def in_flight_tokens(self) -> int:
+        total = 0
+        for g in list(self._groups.values()):
+            for i in g.live_slots():
+                req = g.rows[i]
+                if req is not None:
+                    total += max(0, req.max_new_tokens - len(req.tokens))
+        return total
+
+    def _row_keys(self, ids) -> jax.Array:
+        return self._fold_keys(np.asarray(ids, np.int32))
+
+    def _group_keys(self, g: _Group) -> jax.Array:
+        ids = tuple(int(x) for x in g.row_ids)
+        if g.keys_ids != ids:
+            g.keys = self._row_keys(g.row_ids)
+            g.keys_ids = ids
+        return g.keys
+
+    def _complete(self, req: Request, status: str, detail: str = "") -> None:
+        now = self.now()
+        req.finish(status, now, detail)
+        missed = status != OK or now > req.deadline
+        self.breaker.record(missed)
+        self._count("finished")
+        self._count(status)
+        if status == OK:
+            self._latencies.append(now - req.arrival)
+            self._count("tokens_served", len(req.tokens))
+            if now > req.deadline:
+                self._count("deadline_miss")
+                inc_counter("serve.deadline_miss")
+                trace_event("serve.deadline_miss", cat="serve",
+                            request=req.id,
+                            late_s=round(now - req.deadline, 4))
+            else:
+                self._count("met_deadline")
+                self._count("goodput_tokens", len(req.tokens))
+        elif status == TIMEOUT:
+            self._count("deadline_miss")
+            inc_counter("serve.timeouts")
+        inc_counter(f"serve.{status}")
+
+    # -- the scheduler pass ------------------------------------------------
+    def _tick(self) -> bool:
+        """One scheduler pass: expire, join, advance every group one
+        segment, harvest.  Returns True when any work was done (the loop
+        idles on False).  Synchronous and sleep-free: tests drive it
+        directly under a VirtualClock."""
+        if (self._guard is not None and self._guard.triggered
+                and self._state == READY):
+            # SIGTERM arrived (PreemptionGuard flag): drain, never die
+            # mid-decode — checked here as well as in the loop so inline
+            # (threadless) engines honor the signal too
+            self.begin_drain("sigterm")
+        now = self.now()
+        worked = False
+        # 1. expire queued requests whose deadline already passed
+        for req in self.admission.drop_expired(now):
+            self._complete(req, TIMEOUT, "expired in queue")
+            worked = True
+        # 2. drain-deadline enforcement: past it, cancel everything left
+        if self._state == DRAINING and now >= (self._drain_deadline or 0):
+            for g in self._groups.values():
+                for i in g.live_slots():
+                    self._complete(g.rows[i], CANCELLED,
+                                   "drain timeout")
+                    g.release(i)
+                    worked = True
+            for req in self.admission.drop_expired(float("inf")):
+                self._complete(req, CANCELLED, "drain timeout")
+                worked = True
+            self._groups.clear()
+            return worked
+        # 3. cancel expired resident rows at the boundary
+        for g in self._groups.values():
+            for i in g.live_slots():
+                req = g.rows[i]
+                if req.deadline <= now:
+                    self._complete(req, TIMEOUT, "cancelled at boundary")
+                    trace_event("serve.cancel", cat="serve",
+                                request=req.id, at_step=int(g.t_row[i]))
+                    g.release(i)
+                    worked = True
+        # 4. joins: pull queued work into free slots, bucket by bucket
+        for bucket, lane in self.admission.queued_buckets():
+            g = self._groups.get((bucket, lane))
+            if g is None:
+                g = self._groups[(bucket, lane)] = _Group(
+                    bucket, self.cfg.max_batch)
+            free = g.free_slots()
+            if not free:
+                continue
+            reqs = self.admission.take(bucket, len(free), lane)
+            if reqs:
+                self._join(g, lane, reqs, free[:len(reqs)])
+                worked = True
+        # 5. advance each group one segment
+        for (bucket, lane), g in list(self._groups.items()):
+            if g.live_slots():
+                self._advance(g, lane)
+                worked = True
+            elif not self.admission.pending():
+                # empty group with no queued work: drop the cache memory
+                del self._groups[(bucket, lane)]
+        return worked
+
+    def _join(self, g: _Group, lane: str, reqs: list, slots: list) -> None:
+        """Prefill a join cohort and splice it into the resident batch."""
+        eng = self._engines[lane]
+        variables = self._variables[lane]
+        k = len(reqs)
+        n = 1
+        while n < k:
+            n *= 2
+        n = min(n, g.capacity)
+        prompts = np.zeros((n, g.bucket), np.int32)
+        true_len = np.ones(n, np.int32)
+        live = np.zeros(n, bool)
+        ids = np.zeros(n, np.int32)
+        for j, req in enumerate(reqs):
+            prompts[j, :req.true_len] = req.prompt
+            true_len[j] = req.true_len
+            live[j] = True
+            ids[j] = req.id
+        t0 = monotonic()
+        with span_on_tracer(self._tracer, "serve.prefill", cat="serve",
+                            bucket=g.bucket, cohort=n, joins=k, lane=lane):
+            tok, done, caches = eng.serve_prefill(
+                variables, prompts, true_len, live, self._row_keys(ids))
+            tok_h = np.asarray(tok)
+        self.estimator.observe_prefill(g.bucket, monotonic() - t0)
+        # splice cohort rows into the group
+        if g.caches is None:
+            g.caches = self._empty_caches(eng, g.capacity, g.bucket, lane)
+        g.caches = DecodeEngine.merge_cache_rows(
+            g.caches, caches, slots, list(range(k)))
+        for j, (req, slot) in enumerate(zip(reqs, slots)):
+            g.rows[slot] = req
+            g.tok[slot] = tok_h[j]
+            g.true_len[slot] = req.true_len
+            g.budget[slot] = req.max_new_tokens
+            g.t_row[slot] = 0
+            g.row_ids[slot] = req.id
+            g.done[slot] = False
+            trace_event("serve.join", cat="serve", request=req.id,
+                        bucket=g.bucket, slot=slot, lane=lane)
+            self._emit(g, slot, [int(tok_h[j])])
+
+    def _empty_caches(self, eng: DecodeEngine, capacity: int, bucket: int,
+                      lane: str) -> list:
+        import jax.numpy as jnp
+        module = eng.module
+        dh = module.d_model // module.n_heads
+        w0 = _round_up(bucket + 1, self.cfg.cache_chunk)
+        shape = (capacity, w0, module.n_heads, dh)
+        return [(jnp.zeros(shape, module.dtype),
+                 jnp.zeros(shape, module.dtype))
+                for _ in range(module.n_layers)]
+
+    def _emit(self, g: _Group, slot: int, tokens: list) -> None:
+        """Append emitted tokens to a row's request, honoring its budget
+        and stop tokens; completes (and frees) the row when finished."""
+        req = g.rows[slot]
+        stopped = False
+        for tok in tokens:
+            if len(req.tokens) >= req.max_new_tokens:
+                break
+            req.tokens.append(int(tok))
+            if self._stops.size and int(tok) in self._stops:
+                stopped = True
+                break
+        if stopped or len(req.tokens) >= req.max_new_tokens:
+            self._complete(req, OK)
+            g.release(slot)
+
+    def _advance(self, g: _Group, lane: str) -> None:
+        """Run one mixed-age segment for a group and harvest the results."""
+        eng = self._engines[lane]
+        variables = self._variables[lane]
+        seg = self.cfg.segment_steps
+        live = g.live_slots()
+        max_t = int(g.t_row[live].max()) if live else 0
+        window = eng.serve_window(g.bucket, max_t, seg)
+        t0 = monotonic()
+        with span_on_tracer(self._tracer, "serve.segment", cat="serve",
+                            bucket=g.bucket, lane=lane, seg_len=seg,
+                            window=window, occupancy=round(
+                                len(live) / g.capacity, 3)):
+            caches, toks, tok, done = eng.serve_step(
+                variables, g.caches, np.asarray(g.tok),
+                np.asarray(g.done), g.true_len, g.budget, g.bucket,
+                g.t_row, self._group_keys(g), seg, window)
+            toks_h = np.asarray(toks)
+            tok_h = np.asarray(tok)
+            done_h = np.asarray(done)
+        self.estimator.observe_step(g.bucket, (monotonic() - t0) / seg)
+        g.caches = caches
+        g.tok = tok_h.astype(np.int32)
+        g.done = done_h.astype(bool)
+        for i in live:
+            if g.rows[i] is None:
+                continue
+            self._emit(g, i, toks_h[i].tolist())
+            if g.rows[i] is not None:
+                g.t_row[i] += seg
+        if self._run is not None:
+            self._run.gauge("serve.queue_depth", self.admission.pending())
+            self._run.gauge("serve.in_flight", self.in_flight())
+
+    # -- the loop (spawned by serve/lifecycle.py) -------------------------
+    def _drained(self) -> bool:
+        return (self._state == DRAINING and self.in_flight() == 0
+                and self.admission.pending() == 0)
+
+    def _loop(self) -> None:
+        """The scheduler thread body: tick, check the SIGTERM guard,
+        idle on the condition when there is no work."""
+        while True:
+            if (self._guard is not None and self._guard.triggered
+                    and self._state == READY):
+                self.begin_drain("sigterm")
+            if self._state == STOPPED:
+                return
+            worked = self._tick()
+            if self._drained():
+                self._finish_drain()
+                return
+            if not worked:
+                with self._wake:
+                    self._wake.wait(timeout=0.01)
+
+    # -- stats -------------------------------------------------------------
+    def _percentile(self, q: float) -> Optional[float]:
+        if not self._latencies:
+            return None
+        return float(np.percentile(np.asarray(self._latencies), q))
+
+    def stats(self) -> dict:
+        """Counts + latency percentiles (seconds) + breaker state — the
+        dict the drills, bench arm, and gauges read."""
+        out = dict(self._counts)
+        out["in_flight"] = self.in_flight()
+        out["queued"] = self.admission.pending()
+        out["state"] = self._state
+        out["breaker_state"] = self.breaker.state
+        for name, q in (("p50", 50), ("p95", 95), ("p99", 99)):
+            p = self._percentile(q)
+            out[f"latency_{name}_s"] = round(p, 6) if p is not None else None
+        return out
+
+    def _gauge_stats(self) -> None:
+        if self._run is None:
+            return
+        for name, q in (("p50", 50), ("p95", 95), ("p99", 99)):
+            p = self._percentile(q)
+            if p is not None:
+                self._run.gauge(f"serve.latency_{name}_ms", p * 1e3)
+        for key in ("admitted", "shed", "ok", "timeout", "cancelled",
+                    "degraded", "goodput_tokens"):
+            self._run.gauge(f"serve.{key}", self._counts.get(key, 0))

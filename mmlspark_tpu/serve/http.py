@@ -1,0 +1,152 @@
+"""The stdlib-only HTTP front end: request/response mapping, no policy.
+
+Endpoints (all JSON, all dependency-free — the same zero-dependency
+stance as observe/export.serve_metrics, which typically runs on the
+neighboring port):
+
+    GET  /healthz   liveness: 200 while the process can answer at all
+                    (503 only once the engine has fully stopped)
+    GET  /readyz    readiness: 200 only when warmup has compiled every
+                    bucket program AND the engine is not draining —
+                    the signal a load balancer routes on
+    GET  /statz     the engine's stats dict (counts, percentiles,
+                    breaker state) — the drill/bench scrape surface
+    POST /generate  body {"prompt": [ids], "max_new_tokens"?: n,
+                    "deadline_ms"?: m} -> 200 {"tokens": [...],
+                    "degraded": bool, "latency_ms": x}
+
+Error mapping is the admission contract made visible: shed ->
+429 + Retry-After (Overloaded.retry_after_s), poison -> 400, deadline
+death -> 504, drain cancellation -> 503.  Every error body is JSON with
+an explicit Content-Type; a client can always machine-read why it was
+refused.
+
+This module only DEFINES the handler (`make_handler(engine)`); the
+server itself — thread, socket — is constructed by serve/lifecycle.py,
+the one module lint allows to do so.  The handler sets a socket timeout,
+so a slow or hung client stalls only its own connection thread, never
+the engine: its read raises, the connection drops, everyone else keeps
+streaming.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+
+from mmlspark_tpu.observe.logging import get_logger
+from mmlspark_tpu.serve.admission import InvalidRequest, Overloaded
+from mmlspark_tpu.serve.engine import ServingEngine
+from mmlspark_tpu.serve.request import CANCELLED, OK, TIMEOUT
+
+# socket timeout per connection: a hung client's read/write raises
+# instead of parking a handler thread forever
+CLIENT_TIMEOUT_S = 30.0
+
+
+def make_handler(engine: ServingEngine):
+    """The BaseHTTPRequestHandler subclass bound to one engine."""
+
+    class ServeHandler(http.server.BaseHTTPRequestHandler):
+        timeout = CLIENT_TIMEOUT_S
+        error_content_type = "application/json"
+        error_message_format = '{"error": "%(code)d %(message)s"}\n'
+
+        def _json(self, code: int, payload: dict,
+                  headers: dict = None) -> None:
+            body = (json.dumps(payload) + "\n").encode()
+            try:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                # the client vanished mid-response (hung/killed): its
+                # connection is its own problem — drop it quietly rather
+                # than spraying tracebacks from the handler thread
+                get_logger("serve.http").debug(
+                    "client gone before response (%d)", code)
+
+        # -- health/readiness ------------------------------------------
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+            path = self.path.split("?")[0]
+            if path == "/healthz":
+                if engine.state == "stopped":
+                    self._json(503, {"status": "stopped"})
+                else:
+                    self._json(200, {"status": "ok",
+                                     "state": engine.state})
+            elif path == "/readyz":
+                if engine.ready:
+                    self._json(200, {"ready": True})
+                else:
+                    self._json(503, {"ready": False,
+                                     "state": engine.state})
+            elif path == "/statz":
+                self._json(200, engine.stats())
+            else:
+                self.send_error(404, "unknown path "
+                                "(healthz | readyz | statz | generate)")
+
+        # -- the request front end -------------------------------------
+        def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+            if self.path.split("?")[0] != "/generate":
+                self.send_error(404, "POST /generate only")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(length)
+                body = json.loads(raw.decode() or "{}")
+                prompt = body["prompt"]
+            except Exception as e:  # malformed request == poison: 400
+                self._json(400, {"error": f"bad request body: {e}"})
+                return
+            deadline_ms = body.get("deadline_ms")
+            try:
+                req = engine.submit(
+                    prompt,
+                    max_new_tokens=body.get("max_new_tokens"),
+                    deadline_s=(float(deadline_ms) / 1e3
+                                if deadline_ms is not None else None))
+            except InvalidRequest as e:
+                self._json(400, {"error": str(e)})
+                return
+            except Overloaded as e:
+                self._json(429, {"error": str(e), "reason": e.reason},
+                           {"Retry-After":
+                            f"{max(0.0, e.retry_after_s):.3f}"})
+                return
+            # wait past the deadline by a grace period: the boundary
+            # cancel needs one segment to notice, and a just-late
+            # completion should still return its tokens with the miss
+            # flagged rather than a dangling connection
+            budget = max(0.0, req.deadline - engine.now())
+            req.wait(budget + engine.cfg.drain_timeout_s + 5.0)
+            if not req.finished:
+                self._json(504, {"error": "request did not finish",
+                                 "request": req.id})
+                return
+            if req.status == OK:
+                self._json(200, {
+                    "tokens": list(map(int, req.tokens)),
+                    "request": req.id,
+                    "degraded": bool(req.degraded),
+                    "met_deadline": req.finished_at <= req.deadline,
+                    "latency_ms": round(req.latency_s() * 1e3, 3)})
+            elif req.status == TIMEOUT:
+                self._json(504, {"error": "deadline exceeded",
+                                 "request": req.id})
+            elif req.status == CANCELLED:
+                self._json(503, {"error": "cancelled: engine draining",
+                                 "request": req.id})
+            else:
+                self._json(500, {"error": req.detail or "internal error",
+                                 "request": req.id})
+
+        def log_message(self, fmt, *args):
+            get_logger("serve.http").debug(fmt, *args)
+
+    return ServeHandler

@@ -1,0 +1,89 @@
+"""The serving unit of work: one admitted request and its lifecycle.
+
+A `Request` exists only AFTER admission (shed traffic raises
+`admission.Overloaded` at `submit()` and never allocates state — the
+point of shedding is that refused work costs nothing downstream).  From
+admission on, the request moves through exactly one of these terminal
+statuses:
+
+    ok         completed; `tokens` holds the generation (trimmed after
+               the first stop token), possibly `degraded=True` when the
+               open breaker routed it to the quantized fallback bundle
+    timeout    its deadline passed — cancelled at a segment boundary, or
+               finished too late to count
+    cancelled  the engine drained (SIGTERM / stop) before it could finish
+    error      an internal failure; `detail` carries the reason
+
+Deadlines are ABSOLUTE times on the resilience clock
+(`resilience.clock.get_clock().monotonic()`), so every piece of deadline
+math — admission feasibility, boundary cancellation, drain-by-deadline —
+runs on a `VirtualClock` in tests with zero sleeps (the PR-1 testing
+convention).  Completion is signalled through a `threading.Event`;
+`wait()` is how a front-end thread parks until the scheduler finishes the
+row.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+# terminal statuses
+OK, TIMEOUT, CANCELLED, ERROR = "ok", "timeout", "cancelled", "error"
+
+
+class Request:
+    """One admitted generation request (see module docstring)."""
+
+    __slots__ = ("id", "prompt", "true_len", "bucket", "max_new_tokens",
+                 "arrival", "deadline", "degraded", "tokens", "status",
+                 "detail", "finished_at", "span", "_event")
+
+    def __init__(self, req_id: int, prompt: np.ndarray, bucket: int,
+                 max_new_tokens: int, arrival: float, deadline: float):
+        self.id = req_id
+        self.prompt = prompt                  # (true_len,) int32
+        self.true_len = int(prompt.shape[0])
+        self.bucket = bucket
+        self.max_new_tokens = int(max_new_tokens)
+        self.arrival = float(arrival)
+        self.deadline = float(deadline)
+        self.degraded = False
+        self.tokens: list[int] = []           # emitted generation so far
+        self.status: Optional[str] = None     # terminal status, None = open
+        self.detail: str = ""
+        self.finished_at: Optional[float] = None
+        self.span = None                      # serve.request trace span
+        self._event = threading.Event()
+
+    @property
+    def finished(self) -> bool:
+        return self.status is not None
+
+    def latency_s(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.arrival
+
+    def finish(self, status: str, now: float, detail: str = "") -> None:
+        """Terminal transition (scheduler thread); idempotent — the first
+        status wins, so a drain cancel can never overwrite a completion."""
+        if self.status is not None:
+            return
+        self.status = status
+        self.detail = detail
+        self.finished_at = now
+        if self.span is not None:
+            self.span.attrs.update(
+                status=status, degraded=self.degraded,
+                tokens=len(self.tokens),
+                latency_s=round(now - self.arrival, 6))
+            self.span.finish()
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request reaches a terminal status (front-end
+        threads; the scheduler never calls this).  True when finished."""
+        return self._event.wait(timeout)

@@ -32,6 +32,9 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 echo "== chaos drill (multi-fault recovery scenarios) =="
 python scripts/chaos_drill.py
 
+echo "== serve drill (burst / hung-client / poison / SIGTERM-drain) =="
+python scripts/serve_drill.py
+
 echo "== bench smoke (JSON contract) =="
 python bench.py --smoke
 

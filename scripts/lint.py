@@ -80,6 +80,14 @@ HOT_LOOP_DIRS = {
 TRAIN_DIR = os.path.join("mmlspark_tpu", "train")
 _CKPT_SERIALIZE_CALLS = ("to_bytes", "from_bytes", "write_checkpoint")
 
+# the serving package: thread + HTTP-server CONSTRUCTION is forbidden
+# outside the designated lifecycle module, so the scheduler/admission
+# logic stays synchronous and VirtualClock-testable — concurrency
+# mechanism lives in serve/lifecycle.py (spawn/start_http), policy
+# everywhere else (the same split as resilience/ for sockets)
+SERVE_DIR = os.path.join("mmlspark_tpu", "serve")
+SERVE_LIFECYCLE = os.path.join("mmlspark_tpu", "serve", "lifecycle.py")
+
 # the framework package: raw print()/root-logger output is forbidden here
 # (route through observe.logging); the report CLI is the one whitelisted
 # producer of stdout text
@@ -166,6 +174,22 @@ def _is_f64_reference(node: ast.Attribute) -> bool:
     return node.attr in ("float64", "double")
 
 
+def _in_serve_policy(path: str) -> bool:
+    norm = os.path.normpath(path)
+    return norm.startswith(SERVE_DIR + os.sep) and norm != SERVE_LIFECYCLE
+
+
+def _is_thread_or_server_ctor(node: ast.Call) -> bool:
+    """Matches `threading.Thread(...)` / bare `Thread(...)` and any
+    `*HTTPServer(...)` construction (HTTPServer, ThreadingHTTPServer,
+    http.server.X) — the concurrency constructions serve/lifecycle.py
+    owns exclusively."""
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name == "Thread" or bool(name and name.endswith("HTTPServer"))
+
+
 def _in_package(path: str) -> bool:
     norm = os.path.normpath(path)
     return (norm.startswith(PACKAGE_DIR + os.sep)
@@ -249,7 +273,16 @@ def check_file(path: str) -> list[str]:
     in_hot_loop = _in_hot_loop(path)
     in_package = _in_package(path)
     in_train = _in_train(path)
+    in_serve_policy = _in_serve_policy(path)
     for node in ast.walk(tree):
+        if in_serve_policy and isinstance(node, ast.Call) \
+                and _is_thread_or_server_ctor(node):
+            problems.append(
+                f"{path}:{node.lineno}: thread/HTTP-server construction "
+                f"inside mmlspark_tpu/serve/ outside lifecycle.py — "
+                f"concurrency mechanism belongs in serve/lifecycle.py "
+                f"(spawn/start_http); keep engine/admission logic "
+                f"synchronous and clock-injectable")
         if in_train and isinstance(node, ast.Call) \
                 and _is_ckpt_serialize_call(node):
             problems.append(

@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""Serving chaos drills: prove the engine sheds, degrades, and drains —
+never stalls, never corrupts.
+
+Four scenarios through the PR-7 `Scenario` DSL (resilience/chaos.py),
+each driving a REAL threaded ServingEngine (and, where the fault is a
+client behavior, the real HTTP front end) with a scripted fault from the
+injector:
+
+  burst_arrivals      a burst lands on a tiny queue: admission must shed
+                      (429) instead of letting deadlines die in the
+                      queue, and every completion must be byte-exact
+  hung_client         a client sends half a request and stalls: its
+                      connection may rot, but every other client's
+                      request completes
+  poison_request      malformed prompts (out-of-vocab, over-long) are
+                      rejected 400 without touching neighbors
+  midflight_sigterm   SIGTERM mid-decode: stop admitting, finish or
+                      cancel in-flight by deadline, exit — and every
+                      token served (complete or partial) is a prefix of
+                      the offline reference
+
+Corruption check: greedy decode is deterministic, so each completed
+response must EXACTLY equal `DecodeEngine.generate`'s offline tokens for
+that prompt, and every partial (cancelled) response must be a prefix —
+continuous batching is pure scheduling, never arithmetic.
+
+Runs inside `run_telemetry`, then asserts the run_summary.json `serve`
+timeline carries the expected lifecycle events.  Exit 0 only when every
+scenario and every timeline check passes.  `make serve-drill` is the
+entry point; scripts/check.sh runs it in the gate.
+"""
+
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_bundle():
+    import jax
+
+    from mmlspark_tpu.models.bundle import ModelBundle
+    from mmlspark_tpu.models.definitions import build_model
+    cfg = {"vocab_size": 64, "d_model": 32, "n_heads": 4, "n_layers": 2,
+           "max_len": 64}
+    model = build_model("TransformerLM", cfg)
+    variables = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    return ModelBundle.from_module(model, variables)
+
+
+_REF_ENGINES: dict = {}
+
+
+def reference_tokens(bundle, prompt, max_new):
+    """The offline greedy decode for one prompt: the corruption oracle.
+    Engines cached per budget so the oracle compiles once, not per call."""
+    from mmlspark_tpu.models.generate import DecodeEngine
+    eng = _REF_ENGINES.get(max_new)
+    if eng is None:
+        eng = _REF_ENGINES[max_new] = DecodeEngine(bundle.module(),
+                                                   max_new, chunk=16)
+    b = eng.bucket_for(len(prompt))
+    padded = np.zeros((1, b), np.int32)
+    padded[0, :len(prompt)] = prompt
+    return eng.generate(bundle.variables, padded,
+                        np.asarray([len(prompt)], np.int32))[0].tolist()
+
+
+def make_engine(bundle, **overrides):
+    from mmlspark_tpu.serve import ServeConfig, ServingEngine
+    kw = dict(max_new_tokens=16, max_batch=4, queue_capacity=8,
+              segment_steps=4, default_deadline_s=60.0,
+              drain_timeout_s=20.0, cache_chunk=16)
+    kw.update(overrides)
+    return ServingEngine(bundle, ServeConfig(**kw))
+
+
+def check_outputs(bundle, requests, refs):
+    """(exact_matches, prefix_ok, corrupt) over finished requests."""
+    exact = prefix = corrupt = 0
+    for req in requests:
+        if not req.tokens:
+            continue
+        ref = refs[req.id]
+        got = req.tokens
+        if got == ref[:len(got)]:
+            if len(got) == len(ref):
+                exact += 1
+            else:
+                prefix += 1
+        else:
+            corrupt += 1
+    return exact, prefix, corrupt
+
+
+def drive_workload(bundle, engine, prompts, max_new, deadline_s,
+                   use_signal_steps=False):
+    """Submit `prompts` in order, consulting the chaos injector before
+    each request (serving faults + scripted SIGTERM), then drain.
+    Returns (requests, observation-dict skeleton)."""
+    from mmlspark_tpu.resilience.chaos import get_injector
+    from mmlspark_tpu.serve import Overloaded
+    from mmlspark_tpu.serve.lifecycle import start_engine
+
+    import time
+
+    start_engine(engine, install_sigterm=True)
+    injector = get_injector()
+    requests, shed = [], 0
+    rng = np.random.default_rng(3)
+    i = 0
+    queue = list(prompts)
+    while queue:
+        prompt = queue.pop(0)
+        i += 1
+        for fault in injector.serve_faults_due(i):
+            if fault.kind == "burst":
+                # the burst: `size` extra arrivals land back-to-back NOW
+                # (references are computed after the drain, so the
+                # submission loop is tight enough to actually race the
+                # scheduler for queue slots)
+                queue = [rng.integers(0, 64, (5,)).astype(np.int32)
+                         for _ in range(fault.size)] + queue
+        if use_signal_steps:
+            injector.on_step(i)  # scripted SIGTERM by request index
+            if engine._guard is not None and engine._guard.triggered:
+                # the handler only flags; wait (bounded) for the loop to
+                # notice so post-signal submissions deterministically shed
+                t0 = time.monotonic()
+                while engine.state == "ready" \
+                        and time.monotonic() - t0 < 5.0:
+                    time.sleep(0.005)
+        try:
+            req = engine.submit(prompt, max_new_tokens=max_new,
+                                deadline_s=deadline_s)
+            requests.append(req)
+        except Overloaded:
+            shed += 1
+    for req in requests:
+        req.wait(60.0)
+    engine.stop()
+    refs = {req.id: reference_tokens(bundle, req.prompt.tolist(),
+                                     req.max_new_tokens)
+            for req in requests}
+    exact, prefix, corrupt = check_outputs(bundle, requests, refs)
+    stats = engine.stats()
+    return {
+        "submitted": i,
+        "admitted": len(requests),
+        "shed": shed,
+        "ok": sum(1 for r in requests if r.status == "ok"),
+        "timeout": sum(1 for r in requests if r.status == "timeout"),
+        "cancelled": sum(1 for r in requests if r.status == "cancelled"),
+        "unfinished": sum(1 for r in requests if not r.finished),
+        "exact": exact, "prefix_ok": prefix, "corrupt": corrupt,
+        "drained": stats["state"] == "stopped",
+        "breaker_state": stats["breaker_state"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_burst(bundle):
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+
+    scenario = Scenario(
+        "burst_arrivals",
+        faults=[Fault(kind="burst", at_request=2, size=16)],
+        expect={"min_shed": 1, "min_ok": 4, "corrupt": 0,
+                "unfinished": 0, "drained": True})
+
+    def run():
+        rng = np.random.default_rng(0)
+        engine = make_engine(bundle, queue_capacity=4)
+        prompts = [rng.integers(0, 64, (5,)).astype(np.int32)
+                   for _ in range(6)]
+        return drive_workload(bundle, engine, prompts, max_new=8,
+                              deadline_s=60.0)
+
+    return run_scenario(scenario, run)
+
+
+def scenario_hung_client(bundle):
+    """One client stalls mid-request over a REAL socket; the engine and
+    every other client must be unaffected, and shutdown must stay
+    bounded (the stop_server reaper)."""
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+
+    scenario = Scenario(
+        "hung_client",
+        faults=[Fault(kind="slow_client", at_request=2, seconds=30.0)],
+        expect={"ok": 6, "corrupt": 0, "hung_conn_open": True,
+                "server_stop_bounded": True, "drained": True})
+
+    def run():
+        import http.client
+
+        from mmlspark_tpu.observe.export import stop_server
+        from mmlspark_tpu.resilience.chaos import get_injector
+        from mmlspark_tpu.serve.lifecycle import start_engine, start_http
+
+        engine = make_engine(bundle)
+        start_engine(engine)
+        server = start_http(engine, port=0)
+        port = server.server_address[1]
+        injector = get_injector()
+        rng = np.random.default_rng(1)
+        ok = corrupt = 0
+        hung_sock = None
+        try:
+            for i in range(1, 7):
+                for fault in injector.serve_faults_due(i):
+                    if fault.kind == "slow_client":
+                        # connect, send HALF a request, then just... stop
+                        hung_sock = socket.create_connection(
+                            ("127.0.0.1", port), timeout=5)
+                        hung_sock.sendall(
+                            b"POST /generate HTTP/1.1\r\n"
+                            b"Content-Length: 999\r\n\r\n{\"pro")
+                prompt = rng.integers(0, 64, (5,)).astype(np.int32)
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+                body = json.dumps({"prompt": prompt.tolist(),
+                                   "max_new_tokens": 8})
+                conn.request("POST", "/generate", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = json.loads(resp.read().decode())
+                if resp.status == 200:
+                    ref = reference_tokens(bundle, prompt, 8)
+                    if payload["tokens"] == ref:
+                        ok += 1
+                    else:
+                        corrupt += 1
+                conn.close()
+        finally:
+            stopped_clean = stop_server(server, timeout_s=5.0)
+            engine.stop()
+            hung_open = hung_sock is not None
+            if hung_sock is not None:
+                hung_sock.close()
+        return {"ok": ok, "corrupt": corrupt,
+                "hung_conn_open": hung_open,
+                "server_stop_bounded": stopped_clean,
+                "drained": engine.state == "stopped"}
+
+    return run_scenario(scenario, run)
+
+
+def scenario_poison(bundle):
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+
+    scenario = Scenario(
+        "poison_request",
+        faults=[Fault(kind="poison", at_request=3)],
+        expect={"poison_rejected": 2, "ok": 6, "corrupt": 0,
+                "unfinished": 0, "drained": True})
+
+    def run():
+        from mmlspark_tpu.resilience.chaos import get_injector
+        from mmlspark_tpu.serve import InvalidRequest
+        from mmlspark_tpu.serve.lifecycle import start_engine
+
+        engine = make_engine(bundle)
+        start_engine(engine)
+        injector = get_injector()
+        rng = np.random.default_rng(2)
+        requests, rejected = [], 0
+        for i in range(1, 7):
+            poison = any(f.kind == "poison"
+                         for f in injector.serve_faults_due(i))
+            if poison:
+                # two poison forms: out-of-vocabulary ids and an
+                # impossible budget — both must 400 without side effects
+                for bad in (np.asarray([999999, -3], np.int64),
+                            rng.integers(0, 64, (200,)).astype(np.int32)):
+                    try:
+                        engine.submit(bad, max_new_tokens=8)
+                    except InvalidRequest:
+                        rejected += 1
+            prompt = rng.integers(0, 64, (5,)).astype(np.int32)
+            req = engine.submit(prompt, max_new_tokens=8,
+                                deadline_s=60.0)
+            requests.append(req)
+        for req in requests:
+            req.wait(60.0)
+        engine.stop()
+        refs = {req.id: reference_tokens(bundle, req.prompt.tolist(), 8)
+                for req in requests}
+        exact, prefix, corrupt = check_outputs(bundle, requests, refs)
+        return {"poison_rejected": rejected,
+                "ok": sum(1 for r in requests if r.status == "ok"),
+                "unfinished": sum(1 for r in requests if not r.finished),
+                "corrupt": corrupt,
+                "drained": engine.state == "stopped"}
+
+    return run_scenario(scenario, run)
+
+
+def scenario_midflight_sigterm(bundle):
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+
+    scenario = Scenario(
+        "midflight_sigterm",
+        faults=[Fault(kind="sigterm", step=4)],
+        expect={"min_shed": 1, "corrupt": 0, "min_ok": 1,
+                "unfinished": 0, "drained": True})
+
+    def run():
+        rng = np.random.default_rng(4)
+        engine = make_engine(bundle, drain_timeout_s=30.0)
+        # long generations so the SIGTERM lands mid-decode; requests 5+
+        # arrive AFTER the signal and must shed with reason 'draining'
+        prompts = [rng.integers(0, 64, (5,)).astype(np.int32)
+                   for _ in range(8)]
+        return drive_workload(bundle, engine, prompts, max_new=16,
+                              deadline_s=60.0, use_signal_steps=True)
+
+    return run_scenario(scenario, run)
+
+
+def check_timeline(summary: dict) -> dict:
+    """The run_summary.json serve timeline must carry the lifecycle
+    events the scenarios exercised, in a sane order per drain."""
+    events = [e.get("event") for e in summary.get("serve", [])]
+    checks = {
+        "has_ready": "ready" in events,
+        "has_shed": "shed" in events,
+        "has_drain_start": "drain_start" in events,
+        "has_drain_end": "drain_end" in events,
+        "drain_ordered": (
+            "drain_start" in events and "drain_end" in events
+            and events.index("drain_start") < events.index("drain_end")),
+    }
+    return {"name": "run_summary_timeline",
+            "passed": all(checks.values()),
+            "checks": {k: {"want": True, "got": v, "ok": v}
+                       for k, v in checks.items()},
+            "observed": {"events": events[:40]}}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report only")
+    args = parser.parse_args()
+
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+
+    bundle = build_bundle()
+    reports = []
+    with tempfile.TemporaryDirectory() as td:
+        with run_telemetry(td) as rt:
+            for scenario_fn in (scenario_burst, scenario_hung_client,
+                                scenario_poison,
+                                scenario_midflight_sigterm):
+                reports.append(scenario_fn(bundle))
+            summary = rt.summary()
+        reports.append(check_timeline(rt.finish() or summary))
+
+    passed = all(r["passed"] for r in reports)
+    if args.json:
+        print(json.dumps({"passed": passed, "scenarios": reports}))
+    else:
+        for r in reports:
+            status = "PASS" if r["passed"] else "FAIL"
+            print(f"[{status}] {r['name']}")
+            for key, c in r["checks"].items():
+                mark = "ok" if c["ok"] else "WANT %r GOT %r" % (
+                    c["want"], c["got"])
+                print(f"    {key}: {mark}")
+            if not r["passed"]:
+                print(f"    observed: {r['observed']}")
+        print("SERVE DRILL " + ("OK" if passed else "FAILED"))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,23 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "budget(seconds): per-test duration alert budget "
         "override (compile-heavy distributed-autodiff tests)")
+    config.addinivalue_line(
+        "markers", "requires_env(*capabilities): skip (with the probe's "
+        "reason) when the environment lacks a named capability — see "
+        "tests/capabilities.py for the probe set")
+
+
+def pytest_runtest_setup(item):
+    """The capability gate (tests/capabilities.py): runs BEFORE fixture
+    setup, so an unavailable capability skips the test without ever
+    entering its (possibly expensive, certainly doomed) fixtures."""
+    from capabilities import probe  # tests/ dir is on sys.path (conftest)
+    for marker in item.iter_markers("requires_env"):
+        for name in marker.args:
+            available, reason = probe(name)
+            if not available:
+                pytest.skip(
+                    f"environment capability {name!r} unavailable: {reason}")
 
 
 # -- test-duration alert budgets (reference TestBase.scala:47-68,138-153:

@@ -19,6 +19,11 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "multihost_worker.py")
 
+# every test here spawns the 2-process jax.distributed topology; skip the
+# whole module (with the probe's reason) where cross-process CPU
+# collectives cannot run at all — tests/capabilities.py
+pytestmark = pytest.mark.requires_env("multiprocess_collectives")
+
 
 def _free_port() -> int:
     s = socket.socket()

@@ -27,6 +27,7 @@ def test_wheel_builds_and_carries_native_source(tmp_path):
     assert "mmlspark_tpu/__init__.py" in names
 
 
+@pytest.mark.requires_env("package_installed")
 def test_package_importable_from_anywhere(tmp_path):
     """The installed package must import with a non-repo cwd (no implicit
     reliance on running from the source tree)."""

@@ -1,0 +1,487 @@
+"""Serving runtime tests (serve/): admission, deadlines, continuous
+batching, shedding, degraded mode, drain — all deadline math on a
+VirtualClock with zero sleeps (the PR-1 convention), exact greedy parity
+against the offline DecodeEngine as the corruption oracle.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.bundle import ModelBundle
+from mmlspark_tpu.models.definitions import build_model
+from mmlspark_tpu.models.generate import DecodeEngine
+from mmlspark_tpu.resilience.clock import VirtualClock
+from mmlspark_tpu.serve import (AdmissionController, InvalidRequest,
+                                MissRateBreaker, Overloaded, Request,
+                                ServeConfig, ServingEngine,
+                                StepTimeEstimator)
+
+CFG = {"vocab_size": 64, "d_model": 32, "n_heads": 4, "n_layers": 2,
+       "max_len": 64}
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    model = build_model("TransformerLM", CFG)
+    variables = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    return ModelBundle.from_module(model, variables)
+
+
+@pytest.fixture(scope="module")
+def offline(bundle):
+    """The offline decode oracle: greedy tokens for one prompt."""
+    eng = DecodeEngine(bundle.module(), 12, chunk=16)
+
+    def decode(prompt, max_new=12):
+        assert max_new <= 12
+        b = eng.bucket_for(len(prompt))
+        padded = np.zeros((1, b), np.int32)
+        padded[0, :len(prompt)] = prompt
+        return eng.generate(bundle.variables, padded,
+                            np.asarray([len(prompt)], np.int32)
+                            )[0][:max_new].tolist()
+    return decode
+
+
+def make_engine(bundle, clock, **overrides):
+    kw = dict(max_new_tokens=12, max_batch=4, queue_capacity=8,
+              segment_steps=4, default_deadline_s=100.0,
+              drain_timeout_s=50.0, cache_chunk=16)
+    kw.update(overrides)
+    deg = kw.pop("degraded_bundle", None)
+    return ServingEngine(bundle, ServeConfig(**kw),
+                         degraded_bundle=deg, clock=clock)
+
+
+def drain(engine, requests, max_ticks=200):
+    for _ in range(max_ticks):
+        if all(r.finished for r in requests):
+            return
+        engine._tick()
+    raise AssertionError(
+        f"requests not finished after {max_ticks} ticks: "
+        f"{[r.status for r in requests]}")
+
+
+def _req(clock, bucket=8, n_new=8, deadline_s=10.0, rid=1, plen=5):
+    prompt = np.ones(plen, np.int32)
+    now = clock.monotonic()
+    return Request(rid, prompt, bucket, n_new, now, now + deadline_s)
+
+
+# ---------------------------------------------------------------------------
+# admission control (no engine, pure policy, virtual clock)
+# ---------------------------------------------------------------------------
+
+def test_queue_full_sheds_with_reason():
+    clock = VirtualClock()
+    adm = AdmissionController(2, StepTimeEstimator(), clock=clock)
+    adm.try_admit(_req(clock, rid=1))
+    adm.try_admit(_req(clock, rid=2))
+    with pytest.raises(Overloaded) as e:
+        adm.try_admit(_req(clock, rid=3))
+    assert e.value.reason == "queue_full"
+    assert adm.pending() == 2
+
+
+def test_infeasible_deadline_rejected_only_on_proof():
+    clock = VirtualClock()
+    est = StepTimeEstimator()
+    adm = AdmissionController(8, est, clock=clock)
+    # no evidence yet: a 1ms deadline is not PROVABLY infeasible — admit
+    adm.try_admit(_req(clock, rid=1, deadline_s=0.001))
+    # evidence lands: 1s per decode step makes an 8-token request need
+    # ~8s; a 2s deadline is now provably dead on arrival
+    est.observe_prefill(8, 0.5)
+    est.observe_step(8, 1.0)
+    with pytest.raises(Overloaded) as e:
+        adm.try_admit(_req(clock, rid=2, n_new=8, deadline_s=2.0))
+    assert e.value.reason == "infeasible"
+    # a deadline that clears the estimate still admits (queue wait from
+    # the one queued request is included in the proof)
+    adm.try_admit(_req(clock, rid=3, n_new=8, deadline_s=60.0))
+
+
+def test_admission_close_sheds_as_draining():
+    clock = VirtualClock()
+    adm = AdmissionController(8, StepTimeEstimator(), clock=clock)
+    adm.close()
+    with pytest.raises(Overloaded) as e:
+        adm.try_admit(_req(clock))
+    assert e.value.reason == "draining"
+
+
+def test_estimator_worst_bucket_fallback():
+    est = StepTimeEstimator()
+    assert est.service_s(8, 4) is None
+    est.observe_step(16, 0.25)
+    est.observe_step(32, 1.0)
+    # an unseen bucket must never be UNDER-estimated: worst known wins
+    assert est.step_s(8) == 1.0
+    assert est.service_s(8, 4) == pytest.approx(1.0 * 4)
+    # a KNOWN bucket uses its own estimate, not the fallback
+    assert est.service_s(16, 4) == pytest.approx(0.25 * 4)
+
+
+def test_queue_expiry_dropped():
+    clock = VirtualClock()
+    adm = AdmissionController(8, StepTimeEstimator(), clock=clock)
+    adm.try_admit(_req(clock, rid=1, deadline_s=5.0))
+    adm.try_admit(_req(clock, rid=2, deadline_s=50.0))
+    clock.advance(10.0)
+    expired = adm.drop_expired(clock.monotonic())
+    assert [r.id for r in expired] == [1]
+    assert adm.pending() == 1
+
+
+# ---------------------------------------------------------------------------
+# the deadline-miss-rate breaker
+# ---------------------------------------------------------------------------
+
+def test_miss_rate_breaker_state_machine():
+    clock = VirtualClock()
+    brk = MissRateBreaker("serve-test", window=8, min_samples=4,
+                          miss_rate=0.5, reset_s=5.0, clock=clock)
+    for _ in range(4):
+        brk.record(missed=True)
+    assert brk.state == "open"
+    from mmlspark_tpu.resilience.breaker import CircuitOpenError
+    with pytest.raises(CircuitOpenError):
+        brk.allow()
+    clock.advance(5.1)
+    brk.allow()                       # the half-open probe gets through
+    assert brk.state == "half_open"
+    brk.record(missed=False)          # on-time probe closes the circuit
+    assert brk.state == "closed"
+    # and a missed probe re-opens instead
+    for _ in range(4):
+        brk.record(missed=True)
+    clock.advance(5.1)
+    brk.allow()
+    brk.record(missed=True)
+    assert brk.state == "open"
+
+
+# ---------------------------------------------------------------------------
+# the engine: parity, joins, cancellation, drain — inline, VirtualClock
+# ---------------------------------------------------------------------------
+
+def test_single_request_matches_offline(bundle, offline):
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock)
+    engine.warmup()
+    prompt = np.random.default_rng(0).integers(0, 64, (5,)).astype(np.int32)
+    req = engine.submit(prompt, max_new_tokens=12)
+    drain(engine, [req])
+    assert req.status == "ok"
+    assert req.tokens == offline(prompt, 12)
+
+
+def test_midflight_join_exact_parity(bundle, offline):
+    """A request joining a running batch at a segment boundary must get
+    EXACTLY the tokens it would get alone: continuous batching is
+    scheduling, never arithmetic (dense rows are independent at f32)."""
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock, max_batch=2)
+    engine.warmup()
+    rng = np.random.default_rng(1)
+    p1 = rng.integers(0, 64, (5,)).astype(np.int32)
+    p2 = rng.integers(0, 64, (7,)).astype(np.int32)
+    r1 = engine.submit(p1, max_new_tokens=12)
+    engine._tick()                        # r1 prefilled + first segment
+    assert engine.in_flight() == 1
+    r2 = engine.submit(p2, max_new_tokens=12)   # joins mid-flight
+    drain(engine, [r1, r2])
+    assert r1.tokens == offline(p1, 12)
+    assert r2.tokens == offline(p2, 12)
+
+
+def test_short_rows_free_slots_for_later_arrivals(bundle):
+    """Continuous batching's defining behavior: with capacity 2, a third
+    request must be decoding BEFORE the longest resident finishes."""
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock, max_batch=2, segment_steps=4)
+    engine.warmup()
+    rng = np.random.default_rng(2)
+    short1 = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                           max_new_tokens=2)
+    long1 = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                          max_new_tokens=12)
+    waiting = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                            max_new_tokens=2)
+    engine._tick()
+    assert short1.finished                # budget 2 done in segment 1
+    engine._tick()                        # `waiting` joins the freed slot
+    assert not long1.finished             # the long row is still decoding
+    assert waiting.finished or engine.in_flight() == 2
+    drain(engine, [long1, waiting])
+    assert {r.status for r in (short1, long1, waiting)} == {"ok"}
+
+
+def test_deadline_cancel_at_segment_boundary(bundle, offline):
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock, segment_steps=2)
+    engine.warmup()
+    rng = np.random.default_rng(3)
+    doomed = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                           max_new_tokens=12, deadline_s=5.0)
+    healthy = engine.submit(rng.integers(0, 64, (6,)).astype(np.int32),
+                            max_new_tokens=12, deadline_s=1000.0)
+    engine._tick()
+    assert not doomed.finished
+    clock.advance(10.0)                   # past doomed's deadline
+    engine._tick()                        # boundary cancel
+    assert doomed.status == "timeout"
+    assert len(doomed.tokens) < 12        # it was cut off mid-generation
+    drain(engine, [healthy])
+    assert healthy.status == "ok"
+    assert healthy.tokens == offline(
+        np.asarray(healthy.prompt), 12)
+
+
+def test_queued_request_expires_without_decoding(bundle):
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock, max_batch=1, queue_capacity=4)
+    engine.warmup()
+    rng = np.random.default_rng(4)
+    resident = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                             max_new_tokens=12, deadline_s=1000.0)
+    queued = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                           max_new_tokens=12, deadline_s=3.0)
+    engine._tick()                        # resident occupies the 1 slot
+    clock.advance(5.0)
+    engine._tick()
+    assert queued.status == "timeout"
+    assert queued.tokens == []            # never decoded a single step
+    drain(engine, [resident])
+    assert resident.status == "ok"
+
+
+def test_drain_finishes_in_flight_by_deadline(bundle):
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock, drain_timeout_s=100.0)
+    engine.warmup()
+    rng = np.random.default_rng(5)
+    req = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                        max_new_tokens=8)
+    engine._tick()
+    engine.begin_drain("test")
+    with pytest.raises(Overloaded) as e:
+        engine.submit(rng.integers(0, 64, (5,)).astype(np.int32))
+    assert e.value.reason == "draining"
+    engine.stop()                         # inline drain loop
+    assert req.status == "ok"             # finished, not cancelled
+    assert engine.state == "stopped"
+
+
+def test_drain_deadline_cancels_stragglers(bundle):
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock, drain_timeout_s=2.0,
+                         max_batch=1, queue_capacity=4)
+    engine.warmup()
+    rng = np.random.default_rng(6)
+    resident = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                             max_new_tokens=12)
+    queued = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                           max_new_tokens=12)
+    engine._tick()
+    engine.begin_drain("test")
+    clock.advance(5.0)                    # past the drain deadline
+    engine._tick()
+    assert resident.status == "cancelled"
+    assert queued.status == "cancelled"
+    assert engine.in_flight() == 0
+    assert engine._drained()
+
+
+def test_sigterm_flag_triggers_drain(bundle):
+    from mmlspark_tpu.resilience.preemption import PreemptionGuard
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock)
+    engine.warmup()
+    guard = PreemptionGuard(install=False)
+    engine._guard = guard
+    rng = np.random.default_rng(7)
+    req = engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                        max_new_tokens=4)
+    guard.request()                       # the poller/test form of SIGTERM
+    engine._tick()
+    assert engine.state == "draining"
+    drain(engine, [req])
+    assert req.status == "ok"
+
+
+def test_poison_rejected_without_side_effects(bundle):
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock)
+    engine.warmup()
+    before = dict(engine._counts)
+    with pytest.raises(InvalidRequest):
+        engine.submit(np.asarray([99999], np.int64))     # out of vocab
+    with pytest.raises(InvalidRequest):
+        engine.submit(np.asarray([], np.int32))          # empty
+    with pytest.raises(InvalidRequest):
+        engine.submit(np.ones(200, np.int32))            # over max_len
+    with pytest.raises(InvalidRequest):
+        engine.submit(np.ones(5, np.int32), max_new_tokens=999)
+    assert engine._counts == before       # nothing admitted, nothing shed
+    assert engine.in_flight() == 0 and engine.admission.pending() == 0
+
+
+def test_warmup_precompiles_bucket_programs(bundle):
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock)
+    engine.warmup()
+    eng = engine._engines["primary"]
+    warmed = eng.compiled_programs
+    assert warmed > 0
+    req = engine.submit(np.ones(5, np.int32), max_new_tokens=12)
+    drain(engine, [req])
+    # a full-budget request in the warmed bucket pays ZERO new compiles:
+    # readiness means the deadline never races XLA
+    assert eng.compiled_programs == warmed
+
+
+def test_breaker_open_sheds_without_degraded(bundle):
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock, miss_window=8,
+                         miss_min_samples=4, shed_miss_rate=0.5)
+    engine.warmup()
+    for _ in range(4):
+        engine.breaker.record(missed=True)
+    assert engine.breaker.state == "open"
+    with pytest.raises(Overloaded) as e:
+        engine.submit(np.ones(5, np.int32))
+    assert e.value.reason == "breaker_open"
+    assert e.value.retry_after_s > 0
+
+
+def test_breaker_open_fails_over_to_degraded(bundle):
+    from mmlspark_tpu.quant import quantize_bundle
+    deg_bundle = quantize_bundle(bundle, "int8")
+    clock = VirtualClock()
+    engine = make_engine(bundle, clock, degraded_bundle=deg_bundle,
+                         miss_window=8, miss_min_samples=4,
+                         shed_miss_rate=0.5)
+    engine.warmup()
+    for _ in range(4):
+        engine.breaker.record(missed=True)
+    assert engine.breaker.state == "open"
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, 64, (5,)).astype(np.int32)
+    req = engine.submit(prompt, max_new_tokens=8)
+    assert req.degraded
+    drain(engine, [req])
+    assert req.status == "ok"
+    # the degraded lane decodes the QUANTIZED weights: its tokens must
+    # match the offline int8 bundle, not necessarily the f32 one
+    ref = DecodeEngine(deg_bundle.module(), 8, chunk=16)
+    b = ref.bucket_for(len(prompt))
+    padded = np.zeros((1, b), np.int32)
+    padded[0, :len(prompt)] = prompt
+    expect = ref.generate(deg_bundle.variables, padded,
+                          np.asarray([len(prompt)], np.int32))[0].tolist()
+    assert req.tokens == expect[:8]
+
+
+def test_serve_timeline_and_gauges_in_run_summary(bundle, tmp_path):
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+    clock = VirtualClock()
+    with run_telemetry(str(tmp_path)) as rt:
+        engine = make_engine(bundle, clock, queue_capacity=1, max_batch=1)
+        engine.warmup()
+        rng = np.random.default_rng(9)
+        reqs = [engine.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                              max_new_tokens=4)]
+        shed = 0
+        for _ in range(3):
+            try:
+                reqs.append(engine.submit(
+                    rng.integers(0, 64, (5,)).astype(np.int32),
+                    max_new_tokens=4))
+            except Overloaded:
+                shed += 1
+        drain(engine, reqs)
+        engine.stop()
+        summary = rt.summary()
+    assert shed >= 1
+    events = [e["event"] for e in summary["serve"]]
+    assert "ready" in events and "shed" in events
+    assert events.index("drain_start") < events.index("drain_end")
+    assert summary["gauges"]["serve.latency_p50_ms"]["n"] >= 1
+    # request spans rode the run's tracer
+    assert summary["spans"].get("serve.request", {}).get("count", 0) >= 1
+    with open(tmp_path / "run_summary.json") as f:
+        assert json.load(f)["serve"] == summary["serve"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end over a real socket (threaded engine; event-based waits)
+# ---------------------------------------------------------------------------
+
+def test_http_front_end_end_to_end(bundle, offline):
+    import http.client
+
+    from mmlspark_tpu.serve.lifecycle import start_engine, start_http, \
+        stop_http
+
+    engine = make_engine(bundle, None)
+    start_engine(engine, install_sigterm=False)
+    server = start_http(engine, port=0)
+    port = server.server_address[1]
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+
+        def get(path):
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read().decode() or "{}")
+
+        status, body = get("/healthz")
+        assert status == 200 and body["status"] == "ok"
+        status, body = get("/readyz")
+        assert status == 200 and body["ready"] is True
+
+        prompt = np.random.default_rng(10).integers(
+            0, 64, (5,)).astype(np.int32)
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt": prompt.tolist(),
+                                 "max_new_tokens": 8}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read().decode())
+        assert resp.status == 200
+        assert body["tokens"] == offline(prompt, 8)
+        assert body["met_deadline"] is True
+
+        # poison -> 400 with a machine-readable error
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt": [99999]}))
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert "error" in json.loads(resp.read().decode())
+
+        # unknown path -> 404
+        conn.request("GET", "/nope")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 404
+
+        # drain: readiness flips, new traffic is refused with Retry-After
+        engine.begin_drain("test")
+        status, body = get("/readyz")
+        assert status == 503 and body["ready"] is False
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt": prompt.tolist()}))
+        resp = conn.getresponse()
+        assert resp.status == 429
+        assert resp.getheader("Retry-After") is not None
+        resp.read()
+        conn.close()
+    finally:
+        stop_http(server)
+        engine.stop()
+    assert engine.state == "stopped"

@@ -217,6 +217,77 @@ def test_prometheus_exposition_format():
         assert line.startswith("#") or sample.match(line), line
 
 
+def test_serve_metrics_error_content_type_and_unknown_path():
+    """Hardening contract: unknown paths 404 with an explicit text/plain
+    Content-Type (the stdlib default error page is HTML — wrong for a
+    metrics port whose consumers speak plain text)."""
+    import http.client
+
+    from mmlspark_tpu.observe.export import serve_metrics, stop_server
+    server = serve_metrics(port=0)
+    try:
+        port = server.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/definitely/not/a/path")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 404
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert "404" in body and "<" not in body  # plain text, not HTML
+        conn.close()
+    finally:
+        assert stop_server(server, timeout_s=5.0)
+
+
+def test_serve_metrics_stopped_on_run_exit():
+    """A metrics server bound to a run must be torn down (bounded-time)
+    when the run_telemetry block exits — no leaked scrape ports."""
+    import http.client
+
+    from mmlspark_tpu.observe.export import serve_metrics
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+    with run_telemetry() as rt:
+        server = serve_metrics(port=0, run=rt)
+        port = server.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/metrics")
+        assert conn.getresponse().status == 200
+        conn.close()
+    # the run exit ran the finalizer: the port no longer accepts
+    with pytest.raises(OSError):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+        conn.request("GET", "/metrics")
+        conn.getresponse()
+
+
+def test_breaker_state_gauges_in_prometheus_and_run_summary():
+    """Satellite contract: breaker trips are visible as per-endpoint
+    gauges (Prometheus + run_summary), not just as events."""
+    from mmlspark_tpu.observe.export import prometheus_text
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+    from mmlspark_tpu.resilience.breaker import (breakers_snapshot,
+                                                 get_breaker,
+                                                 reset_breakers)
+    reset_breakers()
+    try:
+        with run_telemetry() as rt:
+            brk = get_breaker("store.example")
+            for _ in range(brk.threshold):
+                brk.record_failure(ConnectionError("down"))
+            assert brk.state == "open"
+            snap = breakers_snapshot()["store.example"]
+            assert snap["state_code"] == 2 and snap["retry_in_s"] > 0
+            text = prometheus_text()
+            assert ('mmlspark_tpu_breaker_state{endpoint='
+                    '"store.example"} 2') in text
+            assert "# TYPE mmlspark_tpu_breaker_retry_in_s gauge" in text
+            gauges = rt.gauges()
+        assert gauges["breaker.store.example.state"]["last"] == 2
+        assert gauges["breaker.store.example.retry_in_s"]["last"] > 0
+    finally:
+        reset_breakers()
+
+
 def test_serve_metrics_http_pull():
     import http.client
 

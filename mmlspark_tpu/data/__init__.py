@@ -1,0 +1,12 @@
+"""Streaming data layer: composable Dataset graphs with parallel map
+workers, bounded prefetch buffers, and span-driven autotuning.
+
+See data/dataset.py for the graph model, data/autotune.py for the
+controller, data/executor.py for the one sanctioned thread-pool
+construction point, and docs/performance.md ("Streaming data layer").
+"""
+
+from mmlspark_tpu.data.autotune import Autotuner
+from mmlspark_tpu.data.dataset import Dataset, DatasetIterator, MapError
+
+__all__ = ["Autotuner", "Dataset", "DatasetIterator", "MapError"]

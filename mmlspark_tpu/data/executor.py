@@ -1,0 +1,31 @@
+"""The data layer's ONE thread-pool construction point.
+
+Every parallel stage in a `Dataset` graph — `map` workers, `prefetch`
+buffers — executes on a `Prefetcher` (parallel/prefetch.py): the
+order-preserving bounded background map that already carries the repo's
+backpressure, exception-at-position, and clean-shutdown contracts.  This
+module is the only place in `mmlspark_tpu/data/` or `mmlspark_tpu/io/`
+allowed to construct one (scripts/lint.py enforces it, the same move as
+serve/'s lifecycle-only thread rule): keeping pool construction in one
+file is what keeps "how many threads does ingestion own?" a one-file
+audit, and what lets the Autotuner assume every stage exposes the
+Prefetcher counter/`set_depth` surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+from mmlspark_tpu.parallel.prefetch import Prefetcher
+
+
+def map_runner(fn: Callable[[Any], Any], items: Iterable, *, depth: int,
+               workers: Optional[int] = None,
+               max_depth: Optional[int] = None,
+               name: str = "map") -> Prefetcher:
+    """Build the executing stage for a parallel map: `fn` runs on worker
+    threads over `items`, results return in item order, at most `depth`
+    staged-but-unconsumed (live-tunable up to `max_depth`).  `depth=0`
+    is the synchronous inline mode (no threads)."""
+    return Prefetcher(fn, items, depth=depth, workers=workers,
+                      max_depth=max_depth, name=name)

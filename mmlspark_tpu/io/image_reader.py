@@ -20,10 +20,10 @@ from typing import Iterator
 from mmlspark_tpu.core.pipeline import check_on_error, record_skipped_rows
 from mmlspark_tpu.core.schema import ColumnMeta, ImageSchema
 from mmlspark_tpu.core.table import DataTable, object_column
-from mmlspark_tpu.io.files import iter_binary_files, read_binary_files
+from mmlspark_tpu.data import Dataset
+from mmlspark_tpu.io.files import read_binary_files
 from mmlspark_tpu.native_loader import native_decode, native_decode_batch
 from mmlspark_tpu.observe.spans import active_timings, span_on
-from mmlspark_tpu.parallel.prefetch import Prefetcher, default_depth
 
 
 def _resolve_on_error(on_error: Optional[str], drop_failures: bool) -> str:
@@ -219,31 +219,13 @@ def read_images_iter(path: str, batch_size: int = 256,
     errors: list = []
     first_shape: Optional[tuple] = None
 
-    def raw_batches():
-        # file enumeration + reads stay sequential (ordering is part of
-        # the contract); each yielded chunk is one decode unit
-        pend_paths: list = []
-        pend_bufs: list = []
-        for p, data in iter_binary_files(path, recursive=recursive,
-                                         sample_ratio=sample_ratio,
-                                         inspect_zip=inspect_zip,
-                                         pattern=pattern, seed=seed):
-            pend_paths.append(p)
-            pend_bufs.append(data)
-            if len(pend_bufs) >= batch_size:
-                yield pend_paths, pend_bufs
-                pend_paths, pend_bufs = [], []
-        if pend_bufs:
-            yield pend_paths, pend_bufs
-
-    def decode_batch(item):
-        # runs on the prefetcher's staging threads: the NEXT batch decodes
-        # (C++ pool / PIL fallback) while the consumer resizes, assembles,
+    def decode_batch(chunk):
+        # runs on the Dataset map workers: the NEXT batch decodes (C++
+        # pool / PIL fallback) while the consumer resizes, assembles,
         # and the caller scores the current one.  Per-row policy checks
         # stay on the consumer thread so failures surface in row order.
-        batch_paths, bufs = item
         with span_on(timings, "host"):
-            return batch_paths, decode_many(bufs)
+            return [p for p, _ in chunk], decode_many([b for _, b in chunk])
 
     def absorb(batch_paths: list, decoded: list) -> None:
         nonlocal first_shape
@@ -293,10 +275,20 @@ def read_images_iter(path: str, batch_size: int = 256,
             batch_errors if policy == "column" else None)
 
     timings = active_timings()
-    # bounded decode lookahead: peak residency is `depth` decoded batches
-    # plus the accumulation buffer, so corpora stay unbounded by host RAM
-    staged = Prefetcher(decode_batch, raw_batches(), depth=default_depth(),
-                        name="decode")
+    # Dataset graph over the file stream: enumeration + reads stay
+    # sequential on the pulling thread (ordering contract), decode runs
+    # on bounded parallel map workers — peak residency is `depth` decoded
+    # batches plus the accumulation buffer, so corpora stay unbounded by
+    # host RAM.  The depth knob (MMLSPARK_TPU_PREFETCH_DEPTH) pins the
+    # lookahead when positive and hands it to the Autotuner when 0.
+    staged = (Dataset
+              .from_files(path, recursive=recursive,
+                          sample_ratio=sample_ratio,
+                          inspect_zip=inspect_zip, pattern=pattern,
+                          seed=seed)
+              .batch(batch_size)
+              .map(decode_batch, name="decode", span=None)
+              .iterator())
     try:
         for batch_paths, decoded in staged:
             absorb(batch_paths, decoded)

@@ -36,7 +36,8 @@ from mmlspark_tpu.observe.trace import (active_tracer, current_span_id,
 from mmlspark_tpu.parallel.bridge import (pad_to_multiple, put_sharded,
                                           replicate_tree, reshard)
 from mmlspark_tpu.parallel.mesh import batch_sharding, best_mesh, replicated
-from mmlspark_tpu.parallel.prefetch import OncePerTable, Prefetcher, default_depth
+from mmlspark_tpu.data import Dataset
+from mmlspark_tpu.parallel.prefetch import OncePerTable, resolve_depth
 
 
 class TPUModel(Transformer):
@@ -62,9 +63,11 @@ class TPUModel(Transformer):
     prefetchDepth = Param(
         None, "pipeline depth: staged batches in flight (host prep + "
         "device_put overlap the compiled forward); None defers to "
-        "MMLSPARK_TPU_PREFETCH_DEPTH, 0 disables overlap entirely "
-        "(synchronous per-batch round trips)", ptype=int,
-        validator=lambda v: v >= 0)
+        "MMLSPARK_TPU_PREFETCH_DEPTH, positive values pin the depth, "
+        "0 hands it to the data-layer Autotuner (parallel/prefetch."
+        "resolve_depth), -1 disables overlap entirely (synchronous "
+        "per-batch round trips — the pre-autotuner meaning of 0)",
+        ptype=int, validator=lambda v: v >= -1)
     computeDtype = Param(
         None, "compute-dtype override for the compiled forward: 'bfloat16' "
         "runs an un-quantized float32 bundle at bf16 MXU rates, 'float32' "
@@ -233,9 +236,10 @@ class TPUModel(Transformer):
 
     def _prefetch_depth(self) -> int:
         """The pipeline depth every dispatch loop uses: the Param when set,
-        else the MMLSPARK_TPU_PREFETCH_DEPTH config default."""
-        depth = self.prefetchDepth
-        return default_depth() if depth is None else max(0, depth)
+        else the MMLSPARK_TPU_PREFETCH_DEPTH config default — resolved
+        through the shared knob contract, so 0 (autotune) yields the
+        autotuner's floor and -1 yields 0 (synchronous)."""
+        return resolve_depth(self.prefetchDepth)[0]
 
     @staticmethod
     def _tensor_column(col: np.ndarray) -> np.ndarray:
@@ -375,11 +379,13 @@ class TPUModel(Transformer):
         time (ruinous over high-latency links).
 
         The host half of every batch — `_tensor_column` stacking, padding,
-        and the host->HBM `device_put` — runs on the `Prefetcher`'s staging
-        threads, overlapping the compiled forward of earlier batches; the
-        dispatch thread only launches `apply_fn` and drains results.
-        `prefetchDepth` bounds staged + in-flight batches (backpressure),
-        and depth 0 collapses to the serial alternating loop.
+        and the host->HBM `device_put` — runs on a `Dataset` map stage's
+        worker threads, overlapping the compiled forward of earlier
+        batches; the dispatch thread only launches `apply_fn` and drains
+        results.  `prefetchDepth` bounds staged + in-flight batches
+        (backpressure): positive pins the window, 0 lets the data-layer
+        Autotuner size it from measured stalls, and -1 collapses to the
+        serial alternating loop.
         """
         self._check_required()
         in_col = self.inputCol
@@ -394,7 +400,6 @@ class TPUModel(Transformer):
                 yield self.transform(table)
             return
         sharding = batch_sharding(mesh)
-        depth = self._prefetch_depth()
         timings = active_timings()  # captured HERE: workers have no context
         # telemetry handles, captured by the same closure rule: the tracer
         # and the phase span id travel into the staging workers by value
@@ -453,7 +458,13 @@ class TPUModel(Transformer):
                 ready.append(
                     rec["table"].with_column(self.outputCol, result))
 
-        staged = Prefetcher(stage, plans(), depth=depth, name="score")
+        staged = (Dataset.from_iterable(plans)
+                  .map(stage, name="score", depth=self.prefetchDepth,
+                       span=None)
+                  .iterator())
+        # the device in-flight window follows the staging depth LIVE, so
+        # an autotuner widen deepens dispatch pipelining in the same step
+        score_runner = staged.stage("score").runner
         try:
             for kind, rec, dev, valid in staged:
                 if rec.get("queued") is None:
@@ -511,7 +522,7 @@ class TPUModel(Transformer):
                     except (AttributeError, RuntimeError):
                         pass
                     in_flight.append((out, valid, rec))
-                    drain(depth)
+                    drain(score_runner.depth)
                 while ready:
                     yield ready.pop(0)
             drain(0)

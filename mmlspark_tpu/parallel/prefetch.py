@@ -46,18 +46,47 @@ from mmlspark_tpu import config
 PREFETCH_DEPTH = config.register(
     "MMLSPARK_TPU_PREFETCH_DEPTH", default=8, ptype=int,
     doc="Default pipeline depth: staged batches in flight per hot loop "
-        "(TPUModel scoring window, image-decode lookahead). 0 disables "
-        "overlap (synchronous per-batch round trips).")
+        "(TPUModel scoring window, image-decode lookahead). Positive "
+        "values pin the depth; 0 means autotune (the data layer's "
+        "Autotuner starts at the DEPTH_FLOOR and resizes from measured "
+        "stage timings); -1 disables overlap (synchronous per-batch "
+        "round trips — what 0 meant before the autotuner existed).")
 
 PREFETCH_WORKERS = config.register(
     "MMLSPARK_TPU_PREFETCH_WORKERS", default=4, ptype=int,
     doc="Staging thread-pool width per prefetcher (clamped to the depth); "
         "threads run host featurize/pad work and the device_put transfer.")
 
+# The autotuner's floor: an autotuned stage starts here and is never
+# narrowed below it, so "autotune" always keeps at least double buffering.
+DEPTH_FLOOR = 2
+
+
+def resolve_depth(value=None) -> tuple:
+    """Resolve a depth knob to `(depth, autotune)`.
+
+    The shared knob contract (prefetchDepth Param, TrainerConfig.
+    prefetch_depth, MMLSPARK_TPU_PREFETCH_DEPTH): `None` defers to the
+    config var; a positive value pins the depth (autotune off); `0`
+    requests autotuning, starting from DEPTH_FLOOR; any negative value
+    means fully synchronous (depth 0, the debugging escape hatch that
+    `0` used to mean).
+    """
+    if value is None:
+        value = int(config.get("MMLSPARK_TPU_PREFETCH_DEPTH"))
+    value = int(value)
+    if value > 0:
+        return value, False
+    if value == 0:
+        return DEPTH_FLOOR, True
+    return 0, False
+
 
 def default_depth() -> int:
-    """The configured pipeline depth (MMLSPARK_TPU_PREFETCH_DEPTH)."""
-    return max(0, int(config.get("MMLSPARK_TPU_PREFETCH_DEPTH")))
+    """The configured pipeline depth (MMLSPARK_TPU_PREFETCH_DEPTH),
+    resolved: positive values pass through, 0 (autotune) resolves to the
+    DEPTH_FLOOR the autotuner starts from, negative to 0 (synchronous)."""
+    return resolve_depth(None)[0]
 
 
 class Prefetcher:
@@ -73,16 +102,21 @@ class Prefetcher:
 
     def __init__(self, fn: Callable[[Any], Any], items: Iterable,
                  *, depth: int, workers: Optional[int] = None,
-                 name: str = "prefetch"):
+                 max_depth: Optional[int] = None, name: str = "prefetch"):
         self._closed = False  # first: __del__ runs even if init raises
         if depth < 0:
             raise ValueError(f"prefetch depth must be >= 0, got {depth}")
         self._fn = fn
         self._items = iter(items)
         self._depth = int(depth)
+        # `max_depth` reserves headroom for live retuning: the pool is
+        # sized for the cap, so `set_depth()` can widen a running stage
+        # without rebuilding threads (the data-layer Autotuner's lever).
+        self._max_depth = (max(self._depth, int(max_depth))
+                           if max_depth is not None else self._depth)
         if workers is None:
             workers = int(config.get("MMLSPARK_TPU_PREFETCH_WORKERS"))
-        self._workers = max(1, min(int(workers), depth or 1))
+        self._workers = max(1, min(int(workers), self._max_depth or 1))
         self._name = name
         self._pending: deque = deque()   # futures, submission order
         self._executor: Optional[ThreadPoolExecutor] = None
@@ -96,7 +130,38 @@ class Prefetcher:
         # pipeline failing to hide host/transfer work).
         from mmlspark_tpu.observe.telemetry import active_run
         self._run = active_run()
+        # always-on counters (cheap: one perf_counter pair per stalled
+        # pull) — the data-layer Autotuner reads these via `stats()` even
+        # when no telemetry run is active
         self.stall_s = 0.0
+        self.stalls = 0      # deliveries that blocked on an unfinished future
+        self.deliveries = 0  # results handed to the consumer
+        self.residency = 0   # sum of staged-queue length at each delivery
+
+    # -- tuning ---------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    @property
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def set_depth(self, depth: int) -> int:
+        """Retune the staged window live, clamped to [1, max_depth];
+        returns the depth actually applied.  A synchronous prefetcher
+        (max_depth 0) has no window to tune and stays at 0."""
+        if self._max_depth <= 0:
+            return 0
+        self._depth = max(1, min(int(depth), self._max_depth))
+        return self._depth
+
+    def stats(self) -> dict:
+        """Counter snapshot for the autotuner (window deltas are the
+        caller's job): deliveries, stalls, stall_s, residency, depth."""
+        return {"deliveries": self.deliveries, "stalls": self.stalls,
+                "stall_s": self.stall_s, "residency": self.residency,
+                "depth": self._depth, "max_depth": self._max_depth}
 
     # -- iteration ------------------------------------------------------
     def __iter__(self) -> Iterator:
@@ -113,7 +178,9 @@ class Prefetcher:
             except StopIteration:
                 self.close()
                 raise
-            return self._fn(item)
+            result = self._fn(item)
+            self.deliveries += 1
+            return result
         try:
             self._top_up()
             if not self._pending:
@@ -123,14 +190,15 @@ class Prefetcher:
                 self.close()
                 raise StopIteration
             fut = self._pending.popleft()
-            if self._run is None:
-                result = fut.result()
-            else:
-                stalled = not fut.done()
-                t0 = time.perf_counter() if stalled else 0.0
-                result = fut.result()
-                if stalled:
-                    self.stall_s += time.perf_counter() - t0
+            stalled = not fut.done()
+            t0 = time.perf_counter() if stalled else 0.0
+            result = fut.result()
+            if stalled:
+                self.stall_s += time.perf_counter() - t0
+                self.stalls += 1
+            self.deliveries += 1
+            self.residency += len(self._pending)
+            if self._run is not None:
                 self._run.gauge(f"prefetch.{self._name}.depth",
                                 len(self._pending))
                 self._run.gauge(f"prefetch.{self._name}.stall_s",

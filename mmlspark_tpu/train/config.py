@@ -55,9 +55,11 @@ class TrainerConfig:
     pipeline_stages: int = 1
     pipeline_microbatches: int = 4
 
-    # input pipeline: staged batches in flight (parallel/prefetch.py) —
-    # batch k+1's index/mask build and device_put overlap the jitted step
-    # k (double buffering); 0 = synchronous staging on the dispatch thread
+    # input pipeline: staged batches in flight (data/dataset.py map stage)
+    # — batch k+1's index/mask build and device_put overlap the jitted
+    # step k (double buffering).  Positive pins the window, 0 hands it to
+    # the data-layer Autotuner, -1 = synchronous staging on the dispatch
+    # thread (the pre-autotuner meaning of 0)
     prefetch_depth: int = 2
 
     # checkpoint/resume (the reference had none, SURVEY section 5)

@@ -51,7 +51,7 @@ from mmlspark_tpu.parallel.bridge import (gather_replicated, gather_to_host,
 from mmlspark_tpu.parallel.distributed import (barrier, initialize_distributed,
                                                is_coordinator, run_collective)
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh, replicated
-from mmlspark_tpu.parallel.prefetch import Prefetcher
+from mmlspark_tpu.data import Dataset
 from mmlspark_tpu.resilience.chaos import get_injector
 from mmlspark_tpu.resilience.checkpoints import (checkpoint_meta,
                                                  checkpoint_name,
@@ -575,8 +575,10 @@ class Trainer:
         # untouched: the plan below yields exactly the (epoch, step, batch)
         # sequence the serial loop fed, and rng consumption order is
         # identical (orders are drawn epoch-by-epoch on the consumer
-        # thread as the prefetcher tops up).
-        depth = max(0, int(getattr(cfg, "prefetch_depth", 2)))
+        # thread as the staging window tops up).  The knob follows the
+        # shared contract (parallel/prefetch.resolve_depth): positive
+        # pins, 0 autotunes from the floor, -1 is fully serial.
+        depth_knob = int(getattr(cfg, "prefetch_depth", 2))
         timings = active_timings()  # captured: workers have no context
         # telemetry (observe/trace.py): the tracer handle and the fit-level
         # span id are captured HERE on the consumer thread and passed into
@@ -683,7 +685,11 @@ class Trainer:
                 emit(f"epoch {cur_epoch}: loss={rec['loss']:.5f} "
                      f"({rec['wall_s']:.1f}s)")
 
-        staged = Prefetcher(stage, plan(), depth=depth, name="train")
+        # NO `prefetch` op below the plan: its pulls must stay on the
+        # consumer thread (rng orders are drawn as the map stage tops up)
+        staged = (Dataset.from_iterable(plan)
+                  .map(stage, name="train", depth=depth_knob, span=None)
+                  .iterator())
         first_exec = True  # the first executed step pays the jit compile
         exec_count = 0     # watchdog warmup: see `dog` below
         with PreemptionGuard(install=bool(ckpt_dir)) as guard:
@@ -799,7 +805,7 @@ class Trainer:
                     # at the step boundary (lockstep under multi-host:
                     # every process must agree before the collective save).
                     # The already-staged next batch is simply discarded —
-                    # Prefetcher.close() below cancels the staging pool.
+                    # staged.close() below cancels the staging pool.
                     preempt_now = guard.triggered
                     if nproc > 1:
                         from jax.experimental import multihost_utils

@@ -133,6 +133,7 @@ def _config_summary() -> list:
     for mod in ("mmlspark_tpu.observe.costmodel",
                 "mmlspark_tpu.observe.history",
                 "mmlspark_tpu.parallel.prefetch",
+                "mmlspark_tpu.data.autotune",
                 "mmlspark_tpu.io.remote",
                 "mmlspark_tpu.resilience.retry",
                 "mmlspark_tpu.resilience.breaker",

@@ -15,6 +15,11 @@ python -m compileall -q mmlspark_tpu tests examples scripts bench.py __graft_ent
 echo "== lint (scripts/lint.py) =="
 python scripts/lint.py
 
+echo "== data-layer contracts (Dataset graph + autotuner) =="
+# explicit early gate: a broken ingestion graph fails fast here before
+# the full suite spends minutes exercising everything built on top of it
+python -m pytest tests/test_data.py -q
+
 echo "== test suite (8-virtual-device CPU mesh) =="
 # fast tier by default (pyproject addopts deselects `slow`); --full runs
 # everything, including the XLA-compile-bound parity tests and example/

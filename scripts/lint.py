@@ -39,6 +39,13 @@ Checks, per file:
     numpy infers float64 from python floats, and an f64 array fed to the
     device either doubles the transfer bytes or hits jax's silent x64
     downcast — hot paths must pin dtypes explicitly
+  * thread-pool / queue / Prefetcher construction inside
+    `mmlspark_tpu/data/` or `mmlspark_tpu/io/` outside the Dataset
+    executor module (`data/executor.py`) — ingestion concurrency is
+    built in exactly one place (the serve/lifecycle.py split), so every
+    stage carries the Prefetcher counter/`set_depth` surface the
+    Autotuner depends on, and "how many threads does ingestion own?"
+    stays a one-file audit
   * tabs in indentation
 """
 
@@ -72,6 +79,9 @@ HOT_LOOP_FILES = {
 # the callers, never happen here directly)
 HOT_LOOP_DIRS = {
     os.path.join("mmlspark_tpu", "quant"),
+    # the Dataset graph runs inside every ingestion hot loop; its timing
+    # rides the Prefetcher counters and observe spans, never raw clocks
+    os.path.join("mmlspark_tpu", "data"),
 }
 
 # the trainer package: checkpoint serialization is forbidden here — it
@@ -87,6 +97,16 @@ _CKPT_SERIALIZE_CALLS = ("to_bytes", "from_bytes", "write_checkpoint")
 # everywhere else (the same split as resilience/ for sockets)
 SERVE_DIR = os.path.join("mmlspark_tpu", "serve")
 SERVE_LIFECYCLE = os.path.join("mmlspark_tpu", "serve", "lifecycle.py")
+
+# the data layer: pool/queue/Prefetcher construction in data/ and io/ is
+# owned exclusively by the Dataset executor module — stages built anywhere
+# else would dodge the autotuner's counter/set_depth surface
+DATA_DIR = os.path.join("mmlspark_tpu", "data")
+IO_DIR = os.path.join("mmlspark_tpu", "io")
+DATA_EXECUTOR = os.path.join("mmlspark_tpu", "data", "executor.py")
+_POOL_CTOR_NAMES = ("ThreadPoolExecutor", "ProcessPoolExecutor", "Thread",
+                    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                    "Prefetcher")
 
 # the framework package: raw print()/root-logger output is forbidden here
 # (route through observe.logging); the report CLI is the one whitelisted
@@ -177,6 +197,25 @@ def _is_f64_reference(node: ast.Attribute) -> bool:
 def _in_serve_policy(path: str) -> bool:
     norm = os.path.normpath(path)
     return norm.startswith(SERVE_DIR + os.sep) and norm != SERVE_LIFECYCLE
+
+
+def _in_data_policy(path: str) -> bool:
+    norm = os.path.normpath(path)
+    if norm == DATA_EXECUTOR:
+        return False
+    return (norm.startswith(DATA_DIR + os.sep)
+            or norm.startswith(IO_DIR + os.sep))
+
+
+def _is_pool_ctor(node: ast.Call) -> bool:
+    """Matches pool/queue/Prefetcher construction (bare name or any
+    attribute chain: `ThreadPoolExecutor(...)`, `queue.Queue(...)`,
+    `Prefetcher(...)`) — the concurrency primitives data/executor.py
+    owns exclusively within data/ and io/."""
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name in _POOL_CTOR_NAMES
 
 
 def _is_thread_or_server_ctor(node: ast.Call) -> bool:
@@ -274,7 +313,16 @@ def check_file(path: str) -> list[str]:
     in_package = _in_package(path)
     in_train = _in_train(path)
     in_serve_policy = _in_serve_policy(path)
+    in_data_policy = _in_data_policy(path)
     for node in ast.walk(tree):
+        if in_data_policy and isinstance(node, ast.Call) \
+                and _is_pool_ctor(node):
+            problems.append(
+                f"{path}:{node.lineno}: thread-pool/queue/Prefetcher "
+                f"construction inside mmlspark_tpu/data/ or /io/ outside "
+                f"data/executor.py — build parallel stages through "
+                f"data.executor.map_runner so the Autotuner sees every "
+                f"stage's counters and depth")
         if in_serve_policy and isinstance(node, ast.Call) \
                 and _is_thread_or_server_ctor(node):
             problems.append(

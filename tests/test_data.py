@@ -1,0 +1,352 @@
+"""Dataset graph contract tests: ordering under out-of-order worker
+completion, seeded shuffle determinism across resume, interleave fan-in,
+on_error row accounting, and autotuner convergence — all deterministic
+(event-based synchronization / synthetic counter windows, no sleeps).
+"""
+
+import threading
+
+import pytest
+
+from mmlspark_tpu.data import Autotuner, Dataset, MapError
+from mmlspark_tpu.observe.metrics import get_counter
+from mmlspark_tpu.observe.telemetry import run_telemetry
+from mmlspark_tpu.parallel.prefetch import DEPTH_FLOOR, resolve_depth
+
+
+# -- depth knob contract -----------------------------------------------------
+
+def test_resolve_depth_contract(monkeypatch):
+    """The shared knob semantics: positive pins, 0 autotunes from the
+    floor, negative is synchronous, None defers to the config var."""
+    assert resolve_depth(5) == (5, False)
+    assert resolve_depth(0) == (DEPTH_FLOOR, True)
+    assert resolve_depth(-1) == (0, False)
+    from mmlspark_tpu import config
+    monkeypatch.setenv("MMLSPARK_TPU_PREFETCH_DEPTH", "3")
+    config.set("MMLSPARK_TPU_PREFETCH_DEPTH", 3)
+    assert resolve_depth(None) == (3, False)
+    config.set("MMLSPARK_TPU_PREFETCH_DEPTH", 0)
+    try:
+        assert resolve_depth(None) == (DEPTH_FLOOR, True)
+    finally:
+        config.set("MMLSPARK_TPU_PREFETCH_DEPTH", 8)
+
+
+# -- map ---------------------------------------------------------------------
+
+def test_map_order_preserved_under_out_of_order_completion():
+    """Item 0's worker is gated until item 3 has finished on another
+    worker — results must still arrive in item order."""
+    gate = threading.Event()
+
+    def fn(i):
+        if i == 0:
+            gate.wait()
+        out = i * 10
+        if i == 3:
+            gate.set()
+        return out
+
+    ds = Dataset.from_iterable(range(8)).map(fn, depth=4, workers=2,
+                                             span=None)
+    assert list(ds.iterator()) == [i * 10 for i in range(8)]
+
+
+def test_map_serial_knob_runs_inline():
+    """depth=-1 (the old 0): no threads, fn runs on the pulling thread."""
+    seen = []
+
+    def fn(i):
+        seen.append(threading.current_thread())
+        return i + 1
+
+    ds = Dataset.from_iterable(range(5)).map(fn, depth=-1, span=None)
+    assert list(ds.iterator()) == [1, 2, 3, 4, 5]
+    assert set(seen) == {threading.main_thread()}
+
+
+def test_map_on_error_fail_surfaces_at_position():
+    """The failing item's exception arrives at exactly its stream
+    position; earlier results are undisturbed."""
+    def fn(i):
+        if i == 3:
+            raise RuntimeError("boom at 3")
+        return i
+
+    it = Dataset.from_iterable(range(6)).map(fn, depth=2, span=None) \
+        .iterator()
+    assert [next(it) for _ in range(3)] == [0, 1, 2]
+    with pytest.raises(RuntimeError, match="boom at 3"):
+        next(it)
+
+
+def test_map_on_error_skip_row_accounting():
+    """Skipped rows are dropped in place, and each drop moves the
+    rows.skipped_on_error counter and rides the run's event stream."""
+    def fn(i):
+        if i % 3 == 0:
+            raise ValueError(f"bad {i}")
+        return i
+
+    with run_telemetry(None) as rt:
+        before = get_counter("rows.skipped_on_error")
+        ds = Dataset.from_iterable(range(9)).map(
+            fn, name="probe", depth=2, span=None, on_error="skip")
+        assert list(ds.iterator()) == [1, 2, 4, 5, 7, 8]
+        assert get_counter("rows.skipped_on_error") == before + 3
+    events = [r for r in rt.tracer.records()
+              if r.get("name") == "rows.skipped"]
+    assert len(events) == 3
+    assert all(e["attrs"]["stage"] == "data.map.probe" for e in events)
+
+
+def test_map_on_error_column_keeps_rows_in_order():
+    def fn(i):
+        if i == 2:
+            raise ValueError("bad 2")
+        return i
+
+    out = list(Dataset.from_iterable(range(4)).map(
+        fn, depth=2, span=None, on_error="column").iterator())
+    assert out[0] == 0 and out[1] == 1 and out[3] == 3
+    assert isinstance(out[2], MapError)
+    assert out[2].item == 2
+    assert isinstance(out[2].error, ValueError)
+
+
+# -- batch / shuffle / interleave / prefetch ---------------------------------
+
+def test_batch_groups_and_remainder():
+    ds = Dataset.from_iterable(range(7)).batch(3)
+    assert list(ds.iterator()) == [[0, 1, 2], [3, 4, 5], [6]]
+    ds = Dataset.from_iterable(range(7)).batch(3, drop_remainder=True)
+    assert list(ds.iterator()) == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_shuffle_is_seeded_and_deterministic_across_iterations():
+    ds = Dataset.from_iterable(lambda: range(100)).shuffle(16, seed=7)
+    first, second = list(ds.iterator()), list(ds.iterator())
+    assert first == second                       # same seed -> same order
+    assert sorted(first) == list(range(100))     # a permutation
+    assert first != list(range(100))             # actually shuffled
+    other = list(Dataset.from_iterable(lambda: range(100))
+                 .shuffle(16, seed=8).iterator())
+    assert other != first                        # seed changes the order
+
+
+def test_shuffle_resume_replays_identically_via_skip():
+    """Resume discipline: re-iterate the seeded stream and skip what the
+    previous run consumed — the tail matches element for element."""
+    ds = Dataset.from_iterable(lambda: range(60)).shuffle(10, seed=3)
+    full = list(ds.iterator())
+    it = ds.iterator()
+    consumed = [next(it) for _ in range(25)]
+    it.close()
+    assert consumed == full[:25]
+    resumed = list(ds.skip(25).iterator())
+    assert resumed == full[25:]
+
+
+def test_interleave_fan_in_round_robin():
+    """cycle_length sub-streams served round-robin; an ended stream's
+    slot is refilled from the next input element — deterministic."""
+    def sub(tag):
+        return Dataset.from_iterable([f"{tag}{i}" for i in range(3)])
+
+    ds = Dataset.from_iterable(["a", "b", "c"]).interleave(
+        sub, cycle_length=2, block_length=1)
+    assert list(ds.iterator()) == ["a0", "b0", "a1", "b1", "a2", "b2",
+                                   "c0", "c1", "c2"]
+
+
+def test_interleave_block_length():
+    def sub(tag):
+        return [f"{tag}{i}" for i in range(4)]  # plain iterables work too
+
+    ds = Dataset.from_iterable(["a", "b"]).interleave(
+        sub, cycle_length=2, block_length=2)
+    assert list(ds.iterator()) == ["a0", "a1", "b0", "b1",
+                                   "a2", "a3", "b2", "b3"]
+
+
+def test_prefetch_preserves_order_and_values():
+    ds = Dataset.from_iterable(lambda: range(50)).prefetch(4)
+    assert list(ds.iterator()) == list(range(50))
+
+
+def test_prefetch_serial_knob_is_passthrough():
+    ds = Dataset.from_iterable(lambda: range(10)).prefetch(-1)
+    it = ds.iterator()
+    assert it.stages == []          # no stage built, no threads
+    assert list(it) == list(range(10))
+
+
+def test_from_table_streams_rows_in_order():
+    import numpy as np
+
+    from mmlspark_tpu import DataTable
+    table = DataTable({"a": np.arange(4), "b": np.arange(4) * 2})
+    rows = list(Dataset.from_table(table).iterator())
+    assert [r["a"] for r in rows] == [0, 1, 2, 3]
+    assert [r["b"] for r in rows] == [0, 2, 4, 6]
+    rows = list(Dataset.from_table(table, columns=["b"]).iterator())
+    assert rows[1] == {"b": 2}
+
+
+def test_iterator_close_shuts_down_stages():
+    ds = Dataset.from_iterable(lambda: range(1000)).map(
+        lambda x: x, depth=4, span=None)
+    it = ds.iterator()
+    assert next(it) == 0
+    runner = it.stage("map").runner
+    it.close()
+    assert list(it) == []           # closed iterator yields nothing
+    assert runner._closed           # the stage pool was released
+
+
+# -- autotuner ---------------------------------------------------------------
+
+class FakeRunner:
+    """A synthetic stage exposing the Prefetcher tuning surface; tests
+    advance its counters window by window — no threads, no clocks."""
+
+    def __init__(self, depth, max_depth):
+        self.depth = depth
+        self.max_depth = max_depth
+        self._c = {"deliveries": 0, "stalls": 0, "stall_s": 0.0,
+                   "residency": 0}
+
+    def stats(self):
+        out = dict(self._c)
+        out["depth"] = self.depth
+        out["max_depth"] = self.max_depth
+        return out
+
+    def set_depth(self, depth):
+        self.depth = max(1, min(int(depth), self.max_depth))
+        return self.depth
+
+    def advance(self, deliveries, stalls, stall_s, residency):
+        self._c["deliveries"] += deliveries
+        self._c["stalls"] += stalls
+        self._c["stall_s"] += stall_s
+        self._c["residency"] += residency
+
+
+class FakeStage:
+    def __init__(self, name, runner):
+        self.name = name
+        self.runner = runner
+
+
+def _skewed_window(slow, fast, w=32, needed_depth=8):
+    """One measurement window of a skewed two-stage pipeline: the slow
+    stage starves the consumer until its window is `needed_depth` deep,
+    then keeps up (mid residency); the fast stage never stalls and its
+    queue rides full."""
+    if slow.depth < needed_depth:
+        slow.advance(w, w, 1.0, 0)
+    else:
+        slow.advance(w, 0, 0.0, (w * slow.depth) // 3)
+    fast.advance(w, 0, 0.0, w * fast.depth)
+
+
+def test_autotuner_widens_bottleneck_and_backs_off_slack():
+    """Convergence on the synthetic skewed pipeline: the stalled stage
+    is widened until its stalls vanish and then holds; the slack stage
+    is narrowed to the floor and held there."""
+    slow = FakeRunner(2, 64)
+    fast = FakeRunner(6, 64)
+    tuner = Autotuner([FakeStage("slow", slow), FakeStage("fast", fast)],
+                      interval=1, floor=2)
+    for _ in range(12):
+        _skewed_window(slow, fast)
+        tuner.step()
+    assert slow.depth >= 8                    # bottleneck widened
+    assert fast.depth == 2                    # slack released to the floor
+    settled = slow.depth
+    for _ in range(6):                        # converged: no oscillation
+        _skewed_window(slow, fast)
+        tuner.step()
+    assert slow.depth == settled
+    assert fast.depth == 2
+    actions = {d["action"] for d in tuner.decisions}
+    assert actions == {"widen", "narrow"}
+    assert all(d["depth_to"] <= 64 for d in tuner.decisions)
+
+
+def test_autotuner_single_widen_per_step_targets_worst_stall():
+    """At most one widen per decision, aimed at the stage the consumer
+    lost the most wall time to."""
+    a = FakeRunner(2, 64)
+    b = FakeRunner(2, 64)
+    tuner = Autotuner([FakeStage("a", a), FakeStage("b", b)],
+                      interval=1, floor=2)
+    a.advance(32, 32, 5.0, 0)   # worst stall_s
+    b.advance(32, 32, 1.0, 0)
+    made = tuner.step()
+    assert [d["stage"] for d in made if d["action"] == "widen"] == ["a"]
+    assert a.depth > 2 and b.depth == 2
+
+
+def test_autotuner_idle_window_makes_no_decision():
+    r = FakeRunner(4, 64)
+    tuner = Autotuner([FakeStage("idle", r)], interval=1, floor=2)
+    assert tuner.step() == []
+    assert r.depth == 4
+
+
+def test_autotuner_publishes_gauges_and_event_stream():
+    """Decisions are visible: data.<stage>.depth gauges plus a
+    `data.autotune` trace event per applied change (cat=data)."""
+    with run_telemetry(None) as rt:
+        slow = FakeRunner(2, 64)
+        tuner = Autotuner([FakeStage("decode", slow)], interval=1, floor=2)
+        slow.advance(32, 32, 2.0, 0)
+        made = tuner.step()
+        assert len(made) == 1
+        events = [r for r in rt.tracer.records()
+                  if r.get("name") == "data.autotune"]
+        assert len(events) == 1
+        assert events[0]["cat"] == "data"
+        assert events[0]["attrs"]["stage"] == "decode"
+        assert events[0]["attrs"]["action"] == "widen"
+        assert rt.gauges()["data.decode.depth"]["last"] == slow.depth
+
+
+def test_autotune_knob_builds_tunable_stage_and_tuner():
+    """depth=0 on an op marks the stage tunable: it starts at the floor
+    with DATA_MAX_DEPTH headroom and the iterator runs a tuner; pinned
+    stages never get one."""
+    ds = Dataset.from_iterable(lambda: range(40)).map(
+        lambda x: x, depth=0, span=None)
+    it = ds.iterator(interval=8)
+    stage = it.stage("map")
+    assert it.tuner is not None
+    assert stage.tunable
+    assert stage.runner.depth == DEPTH_FLOOR
+    assert stage.runner.max_depth >= 64
+    assert list(it) == list(range(40))
+    pinned = Dataset.from_iterable(lambda: range(10)).map(
+        lambda x: x, depth=4, span=None).iterator()
+    assert pinned.tuner is None
+    assert not pinned.stage("map").tunable
+    list(pinned)
+
+
+def test_live_retune_never_reorders_results():
+    """set_depth mid-stream (what the tuner does) must not disturb the
+    ordering contract."""
+    ds = Dataset.from_iterable(lambda: range(200)).map(
+        lambda x: x * 3, depth=0, span=None)
+    it = ds.iterator(autotune=False)   # drive the knob by hand instead
+    runner = it.stage("map").runner
+    out = []
+    for i, v in enumerate(it):
+        out.append(v)
+        if i == 20:
+            assert runner.set_depth(16) == 16
+        if i == 100:
+            assert runner.set_depth(2) == 2
+    assert out == [x * 3 for x in range(200)]

@@ -1,6 +1,6 @@
 # Developer entry points (the reference's `runme` + sbt targets,
 # tools/runme/runme.sh:30-52 + src/project/build.scala).
-.PHONY: check check-full test test-full lint bench bench-smoke bench-history chaos-drill serve-drill tpu-floors install docs notebooks clean
+.PHONY: check check-full test test-full lint bench bench-smoke bench-history chaos-drill serve-drill router-drill tpu-floors install docs notebooks clean
 
 check:            ## full gate: syntax + lint + suite + dryrun + bench smoke
 	bash scripts/check.sh
@@ -34,6 +34,9 @@ chaos-drill:      ## run the multi-fault chaos scenario suite end-to-end (NaN ro
 
 serve-drill:      ## serving chaos scenarios: burst shed, hung client, poison request, mid-flight SIGTERM drain (scripts/serve_drill.py)
 	python scripts/serve_drill.py
+
+router-drill:     ## replica chaos scenarios: crash failover, hang ejection, retry-budget shed, flap re-admission (scripts/router_drill.py)
+	python scripts/router_drill.py
 
 tpu-floors:       ## throughput/MFU floors on a real TPU chip
 	MMLSPARK_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_perf_floor.py -q

@@ -1153,6 +1153,78 @@ def bench_serve(smoke: bool) -> dict:
               if r.status == "ok" and r.finished_at <= r.deadline)
     met_rate = met / len(admitted) if admitted else None
 
+    # -- arm 3: replicated fleet vs one replica ---------------------------
+    # a 2-replica router with ONE replica chaos-degraded (4x slower
+    # ticks) against a single healthy replica behind the same router:
+    # health-aware p2c routing must shift load onto the healthy replica
+    # so the degraded fleet's goodput stays close to the single-healthy
+    # baseline instead of halving — and every completion stays
+    # byte-exact (failover/routing is scheduling, never arithmetic)
+    from mmlspark_tpu.serve import RouterConfig, build_fleet
+
+    def run_router(n_replicas, degrade=None):
+        rcfg = RouterConfig(
+            replicas=n_replicas, queue_capacity=max(n_req, offered),
+            default_deadline_s=600.0, drain_timeout_s=60.0,
+            hang_timeout_s=600.0)
+        # shallow per-replica queues: the burst waits in the ROUTER's
+        # queue and dispatches under backpressure, so placement follows
+        # each replica's live completion rate (the router can observe
+        # the degradation) instead of pre-splitting the burst blindly.
+        # warmup_joins: pre-compile the late-join shape classes so the
+        # timed passes measure routing, not stray XLA compiles
+        rep_scfg = dict(scfg, queue_capacity=max_batch,
+                        warmup_joins=True)
+        router = build_fleet(bundle, cfg=rcfg,
+                             serve_cfg=ServeConfig(**rep_scfg))
+        router.warmup()
+        if degrade is not None:
+            router.replicas[degrade].inject_slow(4.0)
+
+        def pass_once():
+            t_start = time.perf_counter()
+            rr = [router.submit(p, max_new_tokens=b)
+                  for p, b in zip(prompts, budgets)]
+            while any(not r.finished for r in rr):
+                router._tick()
+            return rr, time.perf_counter() - t_start
+
+        pass_once()  # untimed warm: every replica compiles every shape
+        best_wall, best = float("inf"), None
+        for _ in range(reps):
+            rr, wall = pass_once()
+            if wall < best_wall:
+                best_wall, best = wall, rr
+        stats = router.stats()
+        router.stop()
+        return best, best_wall, stats
+
+    fleet_reqs, fleet_wall, fleet_stats = run_router(2, degrade=1)
+    single_reqs, single_wall, _ = run_router(1)
+
+    def goodput(rr, wall):
+        toks = sum(len(r.tokens) for r in rr if r.status == "ok")
+        return toks / wall if wall > 0 else 0.0
+
+    fleet_goodput = goodput(fleet_reqs, fleet_wall)
+    single_goodput = goodput(single_reqs, single_wall)
+    fleet_match = all(r.status == "ok" for r in fleet_reqs)
+    for r in fleet_reqs:
+        if r.status != "ok":
+            continue
+        b = ref_engine.bucket_for(r.true_len)
+        padded = np.zeros((1, b), np.int32)
+        padded[0, :r.true_len] = r.prompt
+        ref = ref_engine.generate(
+            variables, padded,
+            np.asarray([r.true_len], np.int32))[0][:r.max_new_tokens]
+        if r.tokens != ref.tolist():
+            fleet_match = False
+    routed = {name: h["routed"]
+              for name, h in fleet_stats["replicas"].items()}
+    routed_total = sum(routed.values()) or 1
+    healthy_share = routed["r0"] / routed_total
+
     return {
         "metric": "serve_continuous_goodput_tokens_per_sec",
         "value": round(cont_goodput, 1),
@@ -1180,6 +1252,12 @@ def bench_serve(smoke: bool) -> dict:
         "overload_shed": shed,
         "overload_met_deadline_rate": round(met_rate, 4)
         if met_rate is not None else None,
+        "fleet_goodput_tokens_per_sec": round(fleet_goodput, 1),
+        "single_goodput_tokens_per_sec": round(single_goodput, 1),
+        "fleet_vs_single_goodput_ratio": round(
+            fleet_goodput / single_goodput, 3) if single_goodput else None,
+        "fleet_routed_share_healthy": round(healthy_share, 3),
+        "fleet_greedy_match": fleet_match,
     }
 
 

@@ -96,6 +96,10 @@ class RunTelemetry:
         # degrade, drain decisions in order — the machine-readable account
         # the serve drills assert against
         self._serve: list[dict] = []
+        # the run's fleet-routing timeline (serve/router.py): dispatch,
+        # failover, ejection, probe re-admission — what the router drills
+        # assert their failover/ejection sequences against
+        self._routing: list[dict] = []
         # bounded-time cleanups run at finish() (e.g. stopping a metrics
         # server bound to this run) — never allowed to raise or hang the
         # run exit
@@ -219,6 +223,18 @@ class RunTelemetry:
         self.tracer._record({"type": "serve",
                              "ts": round(self.tracer.now(), 6), **rec})
 
+    def record_routing(self, event: dict) -> None:
+        """Append one fleet-routing event (serve/router.py) to the run's
+        ordered timeline (also streamed as a `routing` record); the full
+        list lands in run_summary.json under `routing` — every dispatch,
+        failover, ejection, and probe re-admission, machine-readable."""
+        if not self.live:
+            return
+        rec = dict(event)
+        self._routing.append(rec)
+        self.tracer._record({"type": "routing",
+                             "ts": round(self.tracer.now(), 6), **rec})
+
     # -- finalizers --------------------------------------------------------
     def add_finalizer(self, fn) -> None:
         """Register a cleanup to run at `finish()` (LIFO).  Finalizers
@@ -254,6 +270,7 @@ class RunTelemetry:
             "programs": self.program_summary(),
             "recovery": [dict(e) for e in self._recovery],
             "serve": [dict(e) for e in self._serve],
+            "routing": [dict(e) for e in self._routing],
             "trace_records_dropped": self.tracer.dropped,
         }
 

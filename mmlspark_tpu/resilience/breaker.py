@@ -207,6 +207,17 @@ def get_breaker(endpoint: str) -> CircuitBreaker:
         return breaker
 
 
+def register_breaker(breaker: CircuitBreaker) -> CircuitBreaker:
+    """Put an externally constructed breaker (custom threshold/clock —
+    e.g. the serve router's per-replica ejection breakers) into the
+    process registry so `breakers_snapshot()` and the Prometheus
+    exposition see it like any other endpoint.  Last registration for an
+    endpoint key wins."""
+    with _registry_lock:
+        _breakers[breaker.endpoint] = breaker
+    return breaker
+
+
 def breakers_snapshot() -> dict[str, dict]:
     """Every registered breaker's `snapshot()` by endpoint — the pull
     surface observe/export.py renders as per-endpoint Prometheus gauges

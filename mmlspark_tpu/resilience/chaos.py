@@ -123,19 +123,36 @@ class Fault:
     must be rejected without corrupting any neighbor).  Mid-flight
     SIGTERM drills reuse kind 'sigterm': the driver feeds request indices
     to `on_step`, so `step` doubles as a request index there.
+
+    Replica faults (interpreted by the router drill's workload driver,
+    scripts/router_drill.py, against serve/router.py fleets): they fire
+    at request index `at_request` and act on fleet replica number
+    `replica` — 'replica_crash' (everything in flight on it fails over),
+    'replica_hang' (busy but frozen until the router's hang detector
+    ejects it; recovers after `seconds` on the virtual clock),
+    'replica_flap' (crash now, recover after `seconds` — the half-open
+    probe re-admission drill), 'replica_slow' (tick throttled by
+    `factor` — stays routable until miss evidence ejects it).
     """
 
     kind: str
     step: int = 0            # nan / sigterm / hang trigger step
-    seconds: float = 0.5     # hang / slow-client stall duration (REAL s)
+    seconds: float = 0.5     # hang / slow-client stall duration (REAL s);
+    #                          replica_hang/flap down-time (VIRTUAL s)
     at_write: int = 1        # tear: which checkpoint write (1-based)
     target: str = "payload"  # tear: payload | sidecar | latest
     at_request: int = 1      # serving faults: workload request index (1-based)
     size: int = 8            # burst: how many extra arrivals to inject
+    replica: int = 0         # replica faults: fleet position (0-based)
+    factor: float = 4.0      # replica_slow: tick-throttle factor
 
     _KINDS = ("nan", "sigterm", "hang", "tear",
-              "burst", "slow_client", "poison")
+              "burst", "slow_client", "poison",
+              "replica_crash", "replica_hang", "replica_flap",
+              "replica_slow")
     _SERVE_KINDS = ("burst", "slow_client", "poison")
+    _REPLICA_KINDS = ("replica_crash", "replica_hang", "replica_flap",
+                      "replica_slow")
     _TARGETS = ("payload", "sidecar", "latest")
 
     def __post_init__(self):
@@ -321,6 +338,25 @@ class ChaosInjector:
                 inc_counter(f"chaos.serve_{f.kind}")
                 trace_event(f"chaos.serve_{f.kind}", cat="resilience",
                             request_index=request_index)
+                due.append(f)
+        return due
+
+    def replica_faults_due(self, request_index: int) -> list:
+        """The unfired scripted REPLICA faults due at `request_index`
+        (1-based workload position), each fired at most once.  The
+        router drill's workload driver consults this before issuing each
+        request and acts the fault out on the fleet's `Replica` handles
+        (inject_crash / inject_hang / inject_slow / recover) — the
+        router under test never sees this hook, only a fleet whose
+        members actually fail."""
+        due = []
+        for i, f in enumerate(self.script):
+            if f.kind in Fault._REPLICA_KINDS and i not in self._fired \
+                    and request_index >= f.at_request:
+                self._fired.add(i)
+                inc_counter(f"chaos.{f.kind}")
+                trace_event(f"chaos.{f.kind}", cat="resilience",
+                            request_index=request_index, replica=f.replica)
                 due.append(f)
         return due
 

@@ -15,9 +15,13 @@ ROADMAP's "millions of users" north star needs:
   * `lifecycle` — warmup/readiness, the loop + HTTP threads (the ONE
     module allowed to spawn them — scripts/lint.py), SIGTERM -> graceful
     drain;
+  * `replica` / `router` — the replicated fleet: N engine replicas
+    behind a health-aware routing front tier (power-of-two-choices,
+    outlier ejection with half-open probe re-admission, failover under
+    a retry budget, optional hedging);
   * `http` — stdlib-only request front end + health endpoints
-    (`/healthz`, `/readyz`, POST `/generate`), next to
-    `observe/export.serve_metrics`.
+    (`/healthz`, `/readyz`, POST `/generate` with optional chunked
+    token streaming), next to `observe/export.serve_metrics`.
 
 docs/serving.md has the request lifecycle, policies, and knobs.
 """
@@ -26,11 +30,17 @@ from mmlspark_tpu.serve.admission import (AdmissionController,
                                           InvalidRequest, MissRateBreaker,
                                           Overloaded, StepTimeEstimator)
 from mmlspark_tpu.serve.engine import ServeConfig, ServingEngine
-from mmlspark_tpu.serve.lifecycle import serve_forever, start_engine, start_http
+from mmlspark_tpu.serve.lifecycle import (serve_forever, start_engine,
+                                          start_http, start_router)
+from mmlspark_tpu.serve.replica import Replica, ReplicaUnavailable
 from mmlspark_tpu.serve.request import Request
+from mmlspark_tpu.serve.router import (RetryBudget, Router, RouterConfig,
+                                       RouterRequest, build_fleet)
 
 __all__ = [
     "AdmissionController", "InvalidRequest", "MissRateBreaker",
-    "Overloaded", "Request", "ServeConfig", "ServingEngine",
-    "StepTimeEstimator", "serve_forever", "start_engine", "start_http",
+    "Overloaded", "Replica", "ReplicaUnavailable", "Request",
+    "RetryBudget", "Router", "RouterConfig", "RouterRequest",
+    "ServeConfig", "ServingEngine", "StepTimeEstimator", "build_fleet",
+    "serve_forever", "start_engine", "start_http", "start_router",
 ]

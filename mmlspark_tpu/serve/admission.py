@@ -218,15 +218,41 @@ class AdmissionController:
         self._queue: collections.deque = collections.deque()
         self._lock = threading.Lock()
         self._closed = False
+        # Retry-After hint while draining: the engine passes its drain
+        # budget at close() so 429/503 responses advertise when a
+        # replacement process could plausibly be serving again
+        self.drain_hint_s = 1.0
 
     def _now(self) -> float:
         return (self._clock or get_clock()).monotonic()
 
     # -- scheduler side ---------------------------------------------------
-    def close(self) -> None:
+    def close(self, retry_after_s: Optional[float] = None) -> None:
         """Stop admitting (graceful drain); queued requests stay queued —
-        the drain loop decides their fate by deadline."""
+        the drain loop decides their fate by deadline.  `retry_after_s`
+        becomes the backoff hint shed traffic sees while draining."""
+        if retry_after_s is not None:
+            self.drain_hint_s = max(0.0, float(retry_after_s))
         self._closed = True
+
+    def requeue(self, req: Request) -> None:
+        """Put a request back at the HEAD of the queue: the router could
+        not place it this tick (all replicas full or cooling down), or a
+        failover retry is waiting for re-dispatch — arrival order must
+        be preserved either way."""
+        with self._lock:
+            self._queue.appendleft(req)
+
+    def remove(self, req: Request) -> bool:
+        """Withdraw one still-queued request (a router cancelling the
+        losing hedge attempt, or failing a dead replica's backlog over);
+        True when it was found.  The caller owns finishing it."""
+        with self._lock:
+            try:
+                self._queue.remove(req)
+                return True
+            except ValueError:
+                return False
 
     def pending(self) -> int:
         with self._lock:
@@ -297,16 +323,23 @@ class AdmissionController:
             inc_counter("serve.shed")
             trace_event("serve.shed", cat="serve", reason="draining",
                         request=req.id)
-            raise Overloaded("draining", 1.0, "engine is draining")
+            raise Overloaded("draining", self.drain_hint_s,
+                             "engine is draining")
         with self._lock:
             depth = len(self._queue)
             backlog = sum(r.max_new_tokens for r in self._queue)
         if depth >= self.capacity:
+            # Retry-After derived from evidence, not a constant: the
+            # backlog's estimated drain time, floored by the breaker's
+            # own cooldown when it is open too
             wait = self._queue_wait_s(backlog + in_flight_tokens)
+            hint = wait if wait is not None else 1.0
+            if self.breaker is not None:
+                hint = max(hint, self.breaker.retry_in_s())
             inc_counter("serve.shed")
             trace_event("serve.shed", cat="serve", reason="queue_full",
                         request=req.id, depth=depth)
-            raise Overloaded("queue_full", wait if wait is not None else 1.0,
+            raise Overloaded("queue_full", hint,
                              f"queue at capacity ({depth})")
         # deadline feasibility: reject only on PROOF (estimates exist and
         # the earliest completion still lands past the deadline)
@@ -341,7 +374,8 @@ class AdmissionController:
                 trace_event("serve.degraded", cat="serve", request=req.id)
         with self._lock:
             if self._closed:
-                raise Overloaded("draining", 1.0, "engine is draining")
+                raise Overloaded("draining", self.drain_hint_s,
+                                 "engine is draining")
             self._queue.append(req)
         inc_counter("serve.admitted")
         return lane

@@ -79,6 +79,12 @@ SERVE_DRAIN_TIMEOUT_S = config.register(
     "serving: graceful-drain budget after SIGTERM/stop — in-flight "
     "requests finish or cancel by min(their deadline, this), then the "
     "loop exits", ptype=float)
+SERVE_WARMUP_JOINS = config.register(
+    "MMLSPARK_TPU_SERVE_WARMUP_JOINS", False,
+    "serving: warmup also pre-compiles every late-join shape class "
+    "(cohort merges and terminal segments at each grown cache width) — "
+    "slower startup, but a ready engine then NEVER pays XLA against a "
+    "deadline; recommended for production fleets", ptype=bool)
 
 
 @dataclasses.dataclass
@@ -106,6 +112,7 @@ class ServeConfig:
     shed_miss_rate: float = 0.5
     breaker_reset_s: float = 5.0
     warmup_buckets: tuple = ()        # () = the engine's smallest bucket
+    warmup_joins: Optional[bool] = None  # pre-compile late-join shapes too
 
     def __post_init__(self):
         read = lambda explicit, var, cast: cast(
@@ -119,6 +126,8 @@ class ServeConfig:
                                        SERVE_DEFAULT_DEADLINE_S, float)
         self.drain_timeout_s = read(self.drain_timeout_s,
                                     SERVE_DRAIN_TIMEOUT_S, float)
+        self.warmup_joins = read(self.warmup_joins,
+                                 SERVE_WARMUP_JOINS, bool)
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if self.segment_steps < 1:
@@ -277,9 +286,18 @@ class ServingEngine:
         """Compile every shape class a full-budget batch in this bucket
         can touch: cohort prefills at each power-of-two join width, then
         a dummy capacity batch driven through the whole segment/window
-        ladder — so a ready engine never pays XLA against a deadline."""
+        ladder — so a ready engine never pays XLA against a deadline.
+
+        With `warmup_joins` the sweep also covers what the ladder alone
+        cannot: the cohort-merge program at EVERY grown cache width the
+        batch passes through (a late join splices a fresh base-width
+        cohort into an old, wide batch) and the terminal segment class
+        where the cache has already reached its final width — the
+        shapes an engine otherwise compiles mid-flight, against a live
+        request's deadline, the first time a join lands late."""
         cap = self.cfg.max_batch
         seg = self.cfg.segment_steps
+        cohorts = {}
         n = 1
         while True:
             m = min(n, cap)
@@ -289,12 +307,32 @@ class ServingEngine:
             keys = self._row_keys(np.arange(m))
             tok, done, caches = eng.serve_prefill(variables, prompts, tl,
                                                   live, keys)
+            cohorts[m] = caches
             if n >= cap:
                 break
             n *= 2
+        warmed_widths: set = set()
+
+        def warm_joins(resident) -> None:
+            # one merge program per (resident width, cohort width, join
+            # count): splice k rows from the power-of-two cohort that a
+            # k-wide join would prefill (engine._join pads the same way)
+            width = int(resident[0][0].shape[1])
+            if not self.cfg.warmup_joins or width in warmed_widths:
+                return
+            warmed_widths.add(width)
+            for k in range(1, cap + 1):
+                m = 1
+                while m < k:
+                    m *= 2
+                DecodeEngine.merge_cache_rows(
+                    resident, cohorts[min(m, cap)],
+                    list(range(k)), list(range(k)))
+
         budget = np.full(cap, self.cfg.max_new_tokens, np.int32)
         t_row = np.zeros(cap, np.int32)
         t = 0
+        warm_joins(caches)
         while t < self.cfg.max_new_tokens:
             window = eng.serve_window(bucket, t, seg)
             caches, _, tok, done = eng.serve_step(
@@ -302,6 +340,19 @@ class ServingEngine:
                 keys, seg, window)
             t += seg
             t_row = t_row + seg
+            warm_joins(caches)
+        if self.cfg.warmup_joins:
+            # terminal class: the widest window a live row can demand
+            # (t_row = max_new - 1), entered with the cache already at
+            # that width — the ladder stops one segment short of it
+            final = eng.serve_window(bucket, self.cfg.max_new_tokens - 1,
+                                     seg)
+            for _ in range(2):  # (last-ladder-width -> final), then the
+                # steady state (final -> final); re-runs are cache hits
+                caches, _, tok, done = eng.serve_step(
+                    variables, caches, tok, done, tl, budget, bucket,
+                    t_row, keys, seg, final)
+                warm_joins(caches)
 
     def begin_drain(self, reason: str = "stop") -> None:
         """Stop admitting; in-flight requests finish or cancel by
@@ -312,7 +363,7 @@ class ServingEngine:
                 return
             self._state = DRAINING
             self._drain_deadline = self.now() + self.cfg.drain_timeout_s
-        self.admission.close()
+        self.admission.close(self.cfg.drain_timeout_s)
         inc_counter("serve.drains")
         trace_event("serve.drain_start", cat="serve", reason=reason)
         self._record_serve({"event": "drain_start", "reason": reason,
@@ -382,7 +433,7 @@ class ServingEngine:
             self._count("shed_draining")
             self._count("shed")
             self._record_serve({"event": "shed", "reason": "draining"})
-            raise Overloaded("draining", 1.0,
+            raise Overloaded("draining", self.retry_after_s(),
                              f"engine is {self._state}")
         n_new = int(max_new_tokens if max_new_tokens is not None
                     else self.cfg.max_new_tokens)
@@ -427,6 +478,38 @@ class ServingEngine:
     def _record_serve(self, event: dict) -> None:
         if self._run is not None:
             self._run.record_serve(event)
+
+    def retry_after_s(self) -> float:
+        """The live backoff hint for refused/cancelled traffic: remaining
+        drain time while draining (a replacement process is that far
+        away), the configured drain budget once stopped, and the
+        breaker's own cooldown otherwise — never a bare constant."""
+        now = self.now()
+        if self._state == DRAINING and self._drain_deadline is not None:
+            return max(0.1, self._drain_deadline - now)
+        if self._state == STOPPED:
+            return max(0.1, self.cfg.drain_timeout_s)
+        return max(0.1, self.breaker.retry_in_s())
+
+    def cancel_request(self, req: Request, detail: str = "cancelled") -> bool:
+        """Withdraw one unfinished request — resident row or still queued
+        — WITHOUT feeding the miss breaker (the router cancelling a
+        losing hedge attempt is scheduling, not engine failure).  True
+        when the request was found and cancelled."""
+        if req.finished:
+            return False
+        for g in list(self._groups.values()):
+            for i in g.live_slots():
+                if g.rows[i] is req:
+                    req.finish(CANCELLED, self.now(), detail)
+                    g.release(i)
+                    self._count("cancelled_external")
+                    return True
+        if self.admission.remove(req):
+            req.finish(CANCELLED, self.now(), detail)
+            self._count("cancelled_external")
+            return True
+        return False
 
     def in_flight(self) -> int:
         # list() the dict: submit threads read while the loop thread
@@ -598,16 +681,22 @@ class ServingEngine:
         and stop tokens; completes (and frees) the row when finished."""
         req = g.rows[slot]
         stopped = False
+        appended = False
         for tok in tokens:
             if len(req.tokens) >= req.max_new_tokens:
                 break
             req.tokens.append(int(tok))
+            appended = True
             if self._stops.size and int(tok) in self._stops:
                 stopped = True
                 break
         if stopped or len(req.tokens) >= req.max_new_tokens:
             self._complete(req, OK)
             g.release(slot)
+        elif appended:
+            # segment-boundary flush point: wake any streaming reader
+            # (finish() notifies on its own for the completed case)
+            req.note_tokens()
 
     def _advance(self, g: _Group, lane: str) -> None:
         """Run one mixed-age segment for a group and harvest the results."""

@@ -10,44 +10,67 @@ neighboring port):
                     bucket program AND the engine is not draining —
                     the signal a load balancer routes on
     GET  /statz     the engine's stats dict (counts, percentiles,
-                    breaker state) — the drill/bench scrape surface
+                    breaker state; for a router, per-replica health
+                    sections) — the drill/bench scrape surface
     POST /generate  body {"prompt": [ids], "max_new_tokens"?: n,
                     "deadline_ms"?: m} -> 200 {"tokens": [...],
-                    "degraded": bool, "latency_ms": x}
+                    "degraded": bool, "latency_ms": x}.
+                    With "stream": true the response is chunked
+                    (Transfer-Encoding: chunked) NDJSON: a {"tokens":
+                    [...]} line per segment-boundary flush, a
+                    {"restart": true} line when a router failover
+                    bumped the stream epoch (previously streamed
+                    partials are void), and a final {"done": true,
+                    "status": ..., "tokens": [all]} line carrying the
+                    authoritative full output.
 
 Error mapping is the admission contract made visible: shed ->
-429 + Retry-After (Overloaded.retry_after_s), poison -> 400, deadline
-death -> 504, drain cancellation -> 503.  Every error body is JSON with
-an explicit Content-Type; a client can always machine-read why it was
-refused.
+429 + Retry-After (Overloaded.retry_after_s; a router retry-budget
+shed maps the same way after admission), poison -> 400, deadline
+death -> 504, drain cancellation -> 503 + Retry-After (the engine's
+live `retry_after_s()` — remaining drain time, not a constant).  Every
+error body is JSON with an explicit Content-Type; a client can always
+machine-read why it was refused.
 
-This module only DEFINES the handler (`make_handler(engine)`); the
-server itself — thread, socket — is constructed by serve/lifecycle.py,
-the one module lint allows to do so.  The handler sets a socket timeout,
-so a slow or hung client stalls only its own connection thread, never
-the engine: its read raises, the connection drops, everyone else keeps
-streaming.
+This module only DEFINES the handler (`make_handler(engine)`), bound to
+a `ServingEngine` OR a `Router` — the router duck-types the serving
+surface (submit/stats/state/ready/now/cfg/retry_after_s), so one front
+end serves both.  The server itself — thread, socket — is constructed
+by serve/lifecycle.py, the one module lint allows to do so.  The
+handler sets a socket timeout, so a slow or hung client stalls only its
+own connection thread, never the engine: its read raises, the
+connection drops, everyone else keeps streaming.
 """
 
 from __future__ import annotations
 
 import http.server
 import json
+import time
 
 from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.serve.admission import InvalidRequest, Overloaded
-from mmlspark_tpu.serve.engine import ServingEngine
 from mmlspark_tpu.serve.request import CANCELLED, OK, TIMEOUT
+from mmlspark_tpu.serve.router import SHED
 
 # socket timeout per connection: a hung client's read/write raises
 # instead of parking a handler thread forever
 CLIENT_TIMEOUT_S = 30.0
 
+# streaming poll cadence: how long one stream_wait parks between checks
+# (real seconds — streaming rides the front-end thread, never the
+# scheduler)
+STREAM_POLL_S = 0.05
 
-def make_handler(engine: ServingEngine):
-    """The BaseHTTPRequestHandler subclass bound to one engine."""
+
+def make_handler(engine):
+    """The BaseHTTPRequestHandler subclass bound to one engine/router."""
 
     class ServeHandler(http.server.BaseHTTPRequestHandler):
+        # HTTP/1.1 for Transfer-Encoding: chunked (streaming); every
+        # non-streamed response carries Content-Length, so keep-alive
+        # stays correct
+        protocol_version = "HTTP/1.1"
         timeout = CLIENT_TIMEOUT_S
         error_content_type = "application/json"
         error_message_format = '{"error": "%(code)d %(message)s"}\n'
@@ -123,8 +146,12 @@ def make_handler(engine: ServingEngine):
             # cancel needs one segment to notice, and a just-late
             # completion should still return its tokens with the miss
             # flagged rather than a dangling connection
-            budget = max(0.0, req.deadline - engine.now())
-            req.wait(budget + engine.cfg.drain_timeout_s + 5.0)
+            budget = (max(0.0, req.deadline - engine.now())
+                      + engine.cfg.drain_timeout_s + 5.0)
+            if body.get("stream"):
+                self._stream(req, budget)
+                return
+            req.wait(budget)
             if not req.finished:
                 self._json(504, {"error": "request did not finish",
                                  "request": req.id})
@@ -141,10 +168,72 @@ def make_handler(engine: ServingEngine):
                                  "request": req.id})
             elif req.status == CANCELLED:
                 self._json(503, {"error": "cancelled: engine draining",
-                                 "request": req.id})
+                                 "request": req.id},
+                           {"Retry-After":
+                            f"{engine.retry_after_s():.3f}"})
+            elif req.status == SHED:
+                # router retry-budget exhaustion after admission: the
+                # same 429 contract as front-door shedding
+                self._json(429, {"error": req.detail or "shed",
+                                 "reason": "retry_budget",
+                                 "request": req.id},
+                           {"Retry-After":
+                            f"{max(0.1, req.retry_after_s):.3f}"})
             else:
                 self._json(500, {"error": req.detail or "internal error",
                                  "request": req.id})
+
+        # -- token streaming -------------------------------------------
+        def _chunk(self, payload: dict) -> None:
+            data = (json.dumps(payload) + "\n").encode()
+            self.wfile.write(f"{len(data):X}\r\n".encode()
+                             + data + b"\r\n")
+
+        def _stream(self, req, budget: float) -> None:
+            """Chunked NDJSON: flush tokens as segment boundaries land
+            them (`note_tokens` wakes `stream_wait`), emit a restart
+            line when a failover bumps the stream epoch, then the
+            authoritative final line."""
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                start = time.monotonic()
+                epoch, toks, fin = req.stream_state()
+                cursor = 0
+                while True:
+                    e, toks, fin = req.stream_state()
+                    if e != epoch:
+                        self._chunk({"restart": True, "epoch": e})
+                        epoch, cursor = e, 0
+                    if len(toks) > cursor:
+                        self._chunk({"tokens": list(
+                            map(int, toks[cursor:]))})
+                        cursor = len(toks)
+                    if fin:
+                        break
+                    if time.monotonic() - start > budget:
+                        break
+                    req.stream_wait(epoch, cursor, timeout=STREAM_POLL_S)
+                final = {"done": True,
+                         "status": req.status or "incomplete",
+                         "request": req.id,
+                         "restarts": epoch,
+                         "degraded": bool(req.degraded)}
+                if req.status == OK:
+                    final["tokens"] = list(map(int, req.tokens))
+                    final["met_deadline"] = req.finished_at <= req.deadline
+                    final["latency_ms"] = round(req.latency_s() * 1e3, 3)
+                elif req.status == SHED:
+                    final["retry_after_s"] = round(
+                        max(0.1, req.retry_after_s), 3)
+                self._chunk(final)
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                get_logger("serve.http").debug(
+                    "streaming client gone (request %d)", req.id)
+            self.close_connection = True
 
         def log_message(self, fmt, *args):
             get_logger("serve.http").debug(fmt, *args)

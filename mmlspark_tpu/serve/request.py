@@ -39,7 +39,8 @@ class Request:
 
     __slots__ = ("id", "prompt", "true_len", "bucket", "max_new_tokens",
                  "arrival", "deadline", "degraded", "tokens", "status",
-                 "detail", "finished_at", "span", "_event")
+                 "detail", "finished_at", "span", "_event", "_progress",
+                 "listener")
 
     def __init__(self, req_id: int, prompt: np.ndarray, bucket: int,
                  max_new_tokens: int, arrival: float, deadline: float):
@@ -57,6 +58,9 @@ class Request:
         self.finished_at: Optional[float] = None
         self.span = None                      # serve.request trace span
         self._event = threading.Event()
+        self._progress = threading.Condition()
+        self.listener = None                  # optional progress callback
+        #   (the router bridges attempt progress to its own request)
 
     @property
     def finished(self) -> bool:
@@ -82,8 +86,36 @@ class Request:
                 latency_s=round(now - self.arrival, 6))
             self.span.finish()
         self._event.set()
+        self.note_tokens()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the request reaches a terminal status (front-end
         threads; the scheduler never calls this).  True when finished."""
         return self._event.wait(timeout)
+
+    # -- token streaming ---------------------------------------------------
+    def note_tokens(self) -> None:
+        """Wake streaming waiters (scheduler side, after a segment's
+        tokens land in `tokens` or the request finishes)."""
+        with self._progress:
+            self._progress.notify_all()
+        if self.listener is not None:
+            self.listener()
+
+    def stream_state(self) -> tuple:
+        """(epoch, tokens-so-far, finished) for a chunked-response writer.
+        A plain engine request never restarts, so its epoch is always 0;
+        the router's request bumps the epoch on failover (the streamed-
+        partial caveat in docs/serving.md)."""
+        return 0, list(self.tokens), self.finished
+
+    def stream_wait(self, epoch: int, cursor: int,
+                    timeout: Optional[float] = None) -> bool:
+        """Park until there are tokens past `cursor` (or the request is
+        finished); True when progress is visible.  Streaming front-end
+        threads call this between chunk flushes."""
+        with self._progress:
+            if len(self.tokens) > cursor or self.finished:
+                return True
+            self._progress.wait(timeout)
+            return len(self.tokens) > cursor or self.finished

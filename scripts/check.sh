@@ -40,6 +40,9 @@ python scripts/chaos_drill.py
 echo "== serve drill (burst / hung-client / poison / SIGTERM-drain) =="
 python scripts/serve_drill.py
 
+echo "== router drill (crash-failover / hang-eject / budget-shed / flap-readmit) =="
+python scripts/router_drill.py
+
 echo "== bench smoke (JSON contract) =="
 python bench.py --smoke
 

@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""Replica chaos drills: prove the routing fleet ejects, fails over,
+sheds under a retry budget, and re-admits — with byte-exact outputs.
+
+Four scenarios through the `Scenario` DSL (resilience/chaos.py), each
+driving a REAL router over REAL engine replicas inline under a
+`VirtualClock` (zero sleeps — every deadline, cooldown, and hang
+detection runs on virtual time):
+
+  replica_crash    a replica dies mid-flight: every admitted request
+                   still completes, byte-exact, via failover re-prefill
+                   on a healthy replica; the dead replica is ejected
+  replica_hang     a replica freezes busy: the progress clock trips the
+                   hang detector within the window, its work fails over,
+                   the other replica is unaffected
+  fleet_overload   a loaded replica dies with more in-flight work than
+                   the retry budget holds: exactly `budget` retries are
+                   attempted, the rest shed (429 semantics with a
+                   Retry-After hint) — failures never amplify load
+  replica_flap     crash -> recover: the breaker's half-open PROBE
+                   (a real routed request) re-admits the replica and
+                   normal traffic returns to it
+
+Corruption check: greedy decode is deterministic and a failed-over
+request RE-PREFILLS from scratch, so every completed response must
+EXACTLY equal the offline `DecodeEngine.generate` tokens — failover is
+scheduling, never arithmetic.
+
+Runs inside `run_telemetry`, then asserts the run_summary.json
+`routing` timeline carries the decision events (dispatch / eject /
+failover / readmit / drain).  Exit 0 only when every scenario and the
+timeline pass.  `make router-drill` is the entry point; scripts/check.sh
+runs it in the gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from serve_drill import build_bundle, reference_tokens  # noqa: E402
+
+
+def make_fleet(bundle, clock, *, n=2, serve_overrides=None, **router_kw):
+    from mmlspark_tpu.serve import RouterConfig, ServeConfig, build_fleet
+    skw = dict(max_new_tokens=12, max_batch=4, queue_capacity=8,
+               segment_steps=4, default_deadline_s=60.0,
+               drain_timeout_s=30.0, cache_chunk=16)
+    skw.update(serve_overrides or {})
+    rkw = dict(replicas=n, queue_capacity=32, default_deadline_s=60.0,
+               drain_timeout_s=30.0, retry_budget_cap=8.0,
+               retry_budget_per_s=1.0, eject_failures=3,
+               probe_reset_s=5.0, hang_timeout_s=5.0)
+    rkw.update(router_kw)
+    return build_fleet(bundle, cfg=RouterConfig(**rkw),
+                       serve_cfg=ServeConfig(**skw), clock=clock)
+
+
+def drive_fleet(bundle, router, clock, prompts, max_new, deadline_s, *,
+                inter_arrival_s=0.0, submit_ticks=1, max_ticks=4000):
+    """Submit `prompts` in order, consulting the chaos injector's
+    replica faults before each request and acting them out on the fleet
+    handles; then drive ticks (advancing the virtual clock only when
+    idle) until everything finishes, and drain.  Returns the
+    observation dict the scenarios assert on."""
+    from mmlspark_tpu.resilience.chaos import get_injector
+    from mmlspark_tpu.serve import Overloaded
+
+    router.warmup()
+    injector = get_injector()
+    recoveries = []                    # (replica, due virtual time)
+    routed_at_recovery = {}
+    requests, shed_admission = [], 0
+
+    def run_recoveries():
+        for rep, due in list(recoveries):
+            if router.now() >= due:
+                rep.recover()
+                routed_at_recovery.setdefault(rep.name, rep.routed)
+                recoveries.remove((rep, due))
+
+    for i, prompt in enumerate(prompts, 1):
+        for fault in injector.replica_faults_due(i):
+            rep = router.replicas[fault.replica]
+            if fault.kind == "replica_crash":
+                rep.inject_crash()
+            elif fault.kind == "replica_hang":
+                rep.inject_hang()
+                if fault.seconds > 0:
+                    recoveries.append((rep, router.now() + fault.seconds))
+            elif fault.kind == "replica_flap":
+                rep.inject_crash()
+                recoveries.append((rep, router.now() + fault.seconds))
+            elif fault.kind == "replica_slow":
+                rep.inject_slow(fault.factor)
+        try:
+            requests.append(router.submit(prompt, max_new_tokens=max_new,
+                                          deadline_s=deadline_s))
+        except Overloaded:
+            shed_admission += 1
+        for _ in range(submit_ticks):
+            router._tick()
+        if inter_arrival_s > 0:
+            clock.advance(inter_arrival_s)
+            run_recoveries()
+
+    ticks = 0
+    while not all(r.finished for r in requests) and ticks < max_ticks:
+        run_recoveries()
+        if not router._tick():
+            clock.advance(0.25)
+        ticks += 1
+
+    router.begin_drain("drill")
+    for _ in range(400):
+        if router.state == "stopped":
+            break
+        if not router._tick():
+            clock.advance(1.0)
+
+    exact = corrupt = 0
+    for r in requests:
+        if r.status != "ok":
+            continue
+        if r.tokens == reference_tokens(bundle, r.prompt.tolist(),
+                                        r.max_new_tokens):
+            exact += 1
+        else:
+            corrupt += 1
+    shed_rrs = [r for r in requests if r.status == "shed"]
+    stats = router.stats()
+    obs = {
+        "submitted": len(prompts),
+        "admitted": len(requests),
+        "shed_admission": shed_admission,
+        "ok": sum(1 for r in requests if r.status == "ok"),
+        "timeout": sum(1 for r in requests if r.status == "timeout"),
+        "cancelled": sum(1 for r in requests if r.status == "cancelled"),
+        "error": sum(1 for r in requests if r.status == "error"),
+        "shed_budget": len(shed_rrs),
+        "shed_with_hint": all(r.retry_after_s > 0 for r in shed_rrs),
+        "unfinished": sum(1 for r in requests if not r.finished),
+        "exact": exact, "corrupt": corrupt,
+        "retries": stats.get("retries", 0),
+        "ejections": stats.get("ejections", 0),
+        "readmissions": stats.get("readmissions", 0),
+        "probes": stats.get("probes", 0),
+        "drained": router.state == "stopped",
+    }
+    for name, at_recovery in routed_at_recovery.items():
+        obs[f"{name}_routed_after_recovery"] = \
+            router.stats()["replicas"][name]["routed"] - at_recovery
+    for rep in router.replicas:
+        obs[f"{rep.name}_breaker"] = rep.breaker.state
+        obs[f"{rep.name}_completed"] = rep.completed_ok
+    return obs
+
+
+def prompts_for(seed, n, length=5):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 64, (length,)).astype(np.int32)
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_replica_crash(bundle):
+    """A replica dies mid-flight: zero admitted-request failures —
+    everything completes byte-exact via failover on the survivor."""
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+    from mmlspark_tpu.resilience.clock import VirtualClock
+
+    scenario = Scenario(
+        "replica_crash",
+        faults=[Fault(kind="replica_crash", at_request=5, replica=0)],
+        expect={"ok": 8, "error": 0, "cancelled": 0, "timeout": 0,
+                "shed_budget": 0, "corrupt": 0, "unfinished": 0,
+                "min_retries": 1, "min_ejections": 1, "drained": True})
+
+    def run():
+        clock = VirtualClock()
+        router = make_fleet(bundle, clock)
+        return drive_fleet(bundle, router, clock, prompts_for(10, 8),
+                           max_new=8, deadline_s=60.0)
+
+    return run_scenario(scenario, run)
+
+
+def scenario_replica_hang(bundle):
+    """A replica freezes busy: the progress clock ejects it within the
+    hang window, its work fails over, the healthy replica never
+    notices."""
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+    from mmlspark_tpu.resilience.clock import VirtualClock
+
+    scenario = Scenario(
+        "replica_hang",
+        faults=[Fault(kind="replica_hang", at_request=3, replica=0,
+                      seconds=0.0)],
+        expect={"ok": 8, "error": 0, "cancelled": 0, "shed_budget": 0,
+                "corrupt": 0, "unfinished": 0, "min_ejections": 1,
+                "min_r1_completed": 4, "drained": True})
+
+    def run():
+        clock = VirtualClock()
+        router = make_fleet(bundle, clock, hang_timeout_s=5.0)
+        return drive_fleet(bundle, router, clock, prompts_for(11, 8),
+                           max_new=8, deadline_s=60.0)
+
+    return run_scenario(scenario, run)
+
+
+def scenario_fleet_overload(bundle):
+    """A loaded replica dies with more in-flight work than the retry
+    budget: retries stay <= budget, the rest shed with a Retry-After
+    hint — the fleet never amplifies its own failure into a retry
+    storm."""
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+    from mmlspark_tpu.resilience.clock import VirtualClock
+
+    scenario = Scenario(
+        "fleet_overload",
+        faults=[Fault(kind="replica_crash", at_request=10, replica=0)],
+        expect={"error": 0, "cancelled": 0, "timeout": 0, "corrupt": 0,
+                "unfinished": 0, "max_retries": 1, "min_shed_budget": 1,
+                "shed_with_hint": True, "min_ejections": 1,
+                "drained": True})
+
+    def run():
+        clock = VirtualClock()
+        # a narrow fleet (one decode slot per replica, deep queues) so
+        # arrivals outpace service and backlog builds on the doomed
+        # replica, and a dry-by-design budget: cap 1, no refill — the
+        # crash orphans more work than one retry token covers
+        router = make_fleet(
+            bundle, clock, retry_budget_cap=1.0, retry_budget_per_s=0.0,
+            serve_overrides={"max_batch": 1, "queue_capacity": 8,
+                             "max_new_tokens": 16})
+        return drive_fleet(bundle, router, clock, prompts_for(12, 12),
+                           max_new=16, deadline_s=60.0)
+
+    return run_scenario(scenario, run)
+
+
+def scenario_replica_flap(bundle):
+    """Crash then recover: failed probes keep the replica ejected while
+    it is down; the first on-time probe after recovery re-admits it and
+    normal (non-probe) traffic returns — routing share recovers."""
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+    from mmlspark_tpu.resilience.clock import VirtualClock
+
+    scenario = Scenario(
+        "replica_flap",
+        faults=[Fault(kind="replica_flap", at_request=4, replica=0,
+                      seconds=3.0)],
+        expect={"error": 0, "cancelled": 0, "corrupt": 0,
+                "unfinished": 0, "min_ejections": 1, "min_probes": 1,
+                "min_readmissions": 1, "r0_breaker": "closed",
+                "min_r0_routed_after_recovery": 2, "drained": True})
+
+    def run():
+        clock = VirtualClock()
+        router = make_fleet(bundle, clock, probe_reset_s=1.0)
+        return drive_fleet(bundle, router, clock, prompts_for(13, 16),
+                           max_new=8, deadline_s=60.0,
+                           inter_arrival_s=0.5)
+
+    return run_scenario(scenario, run)
+
+
+def check_timeline(summary: dict) -> dict:
+    """The run_summary.json routing timeline must carry the decision
+    events the scenarios exercised, with ejection before re-admission."""
+    events = [e.get("event") for e in summary.get("routing", [])]
+    checks = {
+        "has_ready": "ready" in events,
+        "has_dispatch": "dispatch" in events,
+        "has_eject": "eject" in events,
+        "has_failover": "failover" in events,
+        "has_readmit": "readmit" in events,
+        "has_drain_start": "drain_start" in events,
+        "has_drain_end": "drain_end" in events,
+        "eject_before_readmit": (
+            "eject" in events and "readmit" in events
+            and events.index("eject") < events.index("readmit")),
+    }
+    return {"name": "routing_timeline",
+            "passed": all(checks.values()),
+            "checks": {k: {"want": True, "got": v, "ok": v}
+                       for k, v in checks.items()},
+            "observed": {"events": events[:60]}}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report only")
+    args = parser.parse_args()
+
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+
+    bundle = build_bundle()
+    reports = []
+    with tempfile.TemporaryDirectory() as td:
+        with run_telemetry(td) as rt:
+            for scenario_fn in (scenario_replica_crash,
+                                scenario_replica_hang,
+                                scenario_fleet_overload,
+                                scenario_replica_flap):
+                reports.append(scenario_fn(bundle))
+            summary = rt.summary()
+        reports.append(check_timeline(rt.finish() or summary))
+
+    passed = all(r["passed"] for r in reports)
+    if args.json:
+        print(json.dumps({"passed": passed, "scenarios": reports}))
+    else:
+        for r in reports:
+            status = "PASS" if r["passed"] else "FAIL"
+            print(f"[{status}] {r['name']}")
+            for key, c in r["checks"].items():
+                mark = "ok" if c["ok"] else "WANT %r GOT %r" % (
+                    c["want"], c["got"])
+                print(f"    {key}: {mark}")
+            if not r["passed"]:
+                print(f"    observed: {r['observed']}")
+        print("ROUTER DRILL " + ("OK" if passed else "FAILED"))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,360 @@
+"""Replicated serving fleet tests (serve/replica.py + serve/router.py):
+health-aware routing, crash/hang ejection, failover under a retry
+budget, half-open probe re-admission, hedging — all deadline/health
+math on a VirtualClock with zero sleeps, exact greedy parity against
+the offline DecodeEngine as the corruption oracle (a failed-over
+request re-prefills, so failover is scheduling, never arithmetic).
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.bundle import ModelBundle
+from mmlspark_tpu.models.definitions import build_model
+from mmlspark_tpu.models.generate import DecodeEngine
+from mmlspark_tpu.resilience.clock import VirtualClock
+from mmlspark_tpu.serve import (RouterConfig, ServeConfig, build_fleet)
+
+CFG = {"vocab_size": 64, "d_model": 32, "n_heads": 4, "n_layers": 2,
+       "max_len": 64}
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    model = build_model("TransformerLM", CFG)
+    variables = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    return ModelBundle.from_module(model, variables)
+
+
+@pytest.fixture(scope="module")
+def offline(bundle):
+    """The offline decode oracle: greedy tokens for one prompt."""
+    eng = DecodeEngine(bundle.module(), 12, chunk=16)
+
+    def decode(prompt, max_new=12):
+        assert max_new <= 12
+        b = eng.bucket_for(len(prompt))
+        padded = np.zeros((1, b), np.int32)
+        padded[0, :len(prompt)] = prompt
+        return eng.generate(bundle.variables, padded,
+                            np.asarray([len(prompt)], np.int32)
+                            )[0][:max_new].tolist()
+    return decode
+
+
+def make_fleet(bundle, clock, n=2, serve_overrides=None, **rkw):
+    skw = dict(max_new_tokens=12, max_batch=4, queue_capacity=8,
+               segment_steps=4, default_deadline_s=100.0,
+               drain_timeout_s=50.0, cache_chunk=16)
+    skw.update(serve_overrides or {})
+    kw = dict(replicas=n, queue_capacity=16, default_deadline_s=100.0,
+              drain_timeout_s=50.0, retry_budget_cap=8.0,
+              retry_budget_per_s=0.5, eject_failures=3,
+              probe_reset_s=5.0, hang_timeout_s=10.0)
+    kw.update(rkw)
+    router = build_fleet(bundle, cfg=RouterConfig(**kw),
+                         serve_cfg=ServeConfig(**skw), clock=clock)
+    router.warmup()
+    return router
+
+
+def drive(router, clock, reqs, max_ticks=600, advance=0.05):
+    """Tick to completion; the virtual clock only advances on idle
+    ticks, so deadlines never expire while work is progressing."""
+    for _ in range(max_ticks):
+        if all(r.finished for r in reqs):
+            return
+        if not router._tick():
+            clock.advance(advance)
+    raise AssertionError(
+        f"requests not finished after {max_ticks} ticks: "
+        f"{[r.status for r in reqs]}")
+
+
+def submit_n(router, n, max_new=8, seed=0, deadline_s=None):
+    rng = np.random.default_rng(seed)
+    return [router.submit(rng.integers(0, 64, (5,)).astype(np.int32),
+                          max_new_tokens=max_new, deadline_s=deadline_s)
+            for _ in range(n)]
+
+
+def busy_replica(router):
+    reps = sorted(router.replicas, key=lambda r: -r.load_tokens())
+    assert reps[0].load_tokens() > 0, "no replica took work"
+    return reps[0]
+
+
+# ---------------------------------------------------------------------------
+# routing + byte-exactness
+# ---------------------------------------------------------------------------
+
+def test_fleet_routes_across_replicas_byte_exact(bundle, offline):
+    clock = VirtualClock()
+    router = make_fleet(bundle, clock)
+    reqs = submit_n(router, 6)
+    drive(router, clock, reqs)
+    assert [r.status for r in reqs] == ["ok"] * 6
+    for r in reqs:
+        assert r.tokens == offline(r.prompt, r.max_new_tokens)
+    # p2c by load spreads a burst over both replicas
+    assert all(rep.routed >= 1 for rep in router.replicas)
+    router.stop()
+    assert router.state == "stopped"
+    assert all(r.engine.state == "stopped" for r in router.replicas)
+
+
+def test_crash_mid_flight_fails_over_byte_exact(bundle, offline):
+    clock = VirtualClock()
+    router = make_fleet(bundle, clock)
+    reqs = submit_n(router, 6)
+    router._tick()                      # dispatch across the fleet
+    victim = busy_replica(router)
+    victim.inject_crash()
+    drive(router, clock, reqs)
+    # zero admitted-request failures: every request completed, exactly
+    assert [r.status for r in reqs] == ["ok"] * 6
+    for r in reqs:
+        assert r.tokens == offline(r.prompt, r.max_new_tokens)
+    stats = router.stats()
+    assert stats["retries"] >= 1        # orphaned work was re-dispatched
+    assert stats["ejections"] >= 1
+    assert victim.breaker.state == "open"
+    # the survivor carried the fleet
+    other = next(r for r in router.replicas if r is not victim)
+    assert other.completed_ok >= 1
+    router.stop()
+
+
+def test_hang_ejected_within_window_others_unaffected(bundle, offline):
+    clock = VirtualClock()
+    router = make_fleet(bundle, clock, hang_timeout_s=2.0)
+    reqs = submit_n(router, 6)
+    router._tick()
+    victim = busy_replica(router)
+    victim.inject_hang()
+    # idle ticks advance the clock past the hang window; the progress
+    # clock trips, the hung replica is ejected, its work fails over
+    drive(router, clock, reqs, advance=0.5)
+    assert [r.status for r in reqs] == ["ok"] * 6
+    for r in reqs:
+        assert r.tokens == offline(r.prompt, r.max_new_tokens)
+    stats = router.stats()
+    assert stats["ejections"] >= 1
+    assert victim.breaker.state == "open"
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# retry budget
+# ---------------------------------------------------------------------------
+
+def test_retry_budget_exhaustion_sheds_never_loops(bundle):
+    clock = VirtualClock()
+    # a budget that is dry by construction: every failover must shed
+    router = make_fleet(bundle, clock, retry_budget_cap=0.0,
+                        retry_budget_per_s=0.0)
+    reqs = submit_n(router, 6)
+    router._tick()
+    busy_replica(router).inject_crash()
+    drive(router, clock, reqs)
+    shed = [r for r in reqs if r.status == "shed"]
+    assert shed, [r.status for r in reqs]
+    for r in shed:
+        # shed at the failover decision with a live backoff hint —
+        # exactly one attempt, never re-queued into a retry loop
+        assert len(r.attempts) == 1
+        assert r.retry_after_s > 0
+    assert router.stats()["shed_retry_budget"] == len(shed)
+    assert router.stats().get("retries", 0) == 0
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# probe re-admission
+# ---------------------------------------------------------------------------
+
+def test_recovered_replica_readmitted_via_probe(bundle, offline):
+    clock = VirtualClock()
+    router = make_fleet(bundle, clock, probe_reset_s=5.0)
+    reqs = submit_n(router, 6)
+    router._tick()
+    victim = busy_replica(router)
+    victim.inject_crash()
+    drive(router, clock, reqs)
+    assert victim.breaker.state == "open"
+    # probes to the still-dead replica fail and re-open the breaker
+    clock.advance(6.0)
+    probe_req = submit_n(router, 1, seed=7)[0]
+    drive(router, clock, [probe_req])
+    assert probe_req.status == "ok"
+    assert victim.breaker.state == "open"
+    # recovery + cooldown: the next request IS the half-open probe; on
+    # on-time completion the replica is re-admitted
+    victim.recover()
+    clock.advance(6.0)
+    late = submit_n(router, 4, seed=8)
+    drive(router, clock, late)
+    assert [r.status for r in late] == ["ok"] * 4
+    for r in late:
+        assert r.tokens == offline(r.prompt, r.max_new_tokens)
+    stats = router.stats()
+    assert stats["probes"] >= 1
+    assert stats["readmissions"] >= 1
+    assert victim.breaker.state == "closed"
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# hedging
+# ---------------------------------------------------------------------------
+
+def test_hedge_launches_second_attempt_near_deadline(bundle, offline):
+    clock = VirtualClock()
+    router = make_fleet(bundle, clock, hedge_fraction=100.0)
+    # estimator evidence makes the deadline look tight relative to the
+    # estimated service time (observations ride REAL time, so inject
+    # them directly rather than decoding for a virtual hour)
+    router.estimator.observe_prefill(8, 1.0)
+    router.estimator.observe_step(8, 1.0)
+    req = submit_n(router, 1, deadline_s=100.0)[0]
+    drive(router, clock, [req])
+    assert req.status == "ok"
+    assert req.tokens == offline(req.prompt, req.max_new_tokens)
+    assert req.hedged
+    assert len(req.attempts) == 2
+    assert {name for name, _ in req.attempts} == {"r0", "r1"}
+    assert router.stats()["hedges"] == 1
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# stats / observability
+# ---------------------------------------------------------------------------
+
+def test_stats_carry_per_replica_health_sections(bundle):
+    clock = VirtualClock()
+    router = make_fleet(bundle, clock)
+    reqs = submit_n(router, 4, max_new=12)
+    rows = []
+    for _ in range(6):                  # tick until work is resident
+        router._tick()
+        stats = router.stats()
+        rows = [row for h in stats["replicas"].values()
+                for row in h["in_flight_rows"]]
+        if rows:
+            break
+    assert set(stats["replicas"]) == {"r0", "r1"}
+    for health in stats["replicas"].values():
+        assert {"state", "routable", "breaker", "miss_ewma",
+                "in_flight", "queued", "in_flight_rows", "routed",
+                "completed_ok"} <= set(health)
+        assert health["breaker"]["state"] in ("closed", "half_open",
+                                              "open")
+    assert rows, "no in-flight rows after dispatch"
+    assert {"request", "bucket", "tokens", "deadline_in_s"} \
+        <= set(rows[0])
+    drive(router, clock, reqs)
+    router.stop()
+
+
+def test_routing_timeline_in_run_summary(bundle, tmp_path):
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+    clock = VirtualClock()
+    with run_telemetry(str(tmp_path)) as rt:
+        router = make_fleet(bundle, clock)
+        reqs = submit_n(router, 6)
+        router._tick()
+        busy_replica(router).inject_crash()
+        drive(router, clock, reqs)
+        router.stop()
+        summary = rt.summary()
+    assert [r.status for r in reqs] == ["ok"] * 6
+    events = [e["event"] for e in summary["routing"]]
+    for expected in ("ready", "dispatch", "eject", "failover",
+                     "drain_start", "drain_end"):
+        assert expected in events, (expected, events)
+    assert events.index("ready") < events.index("dispatch")
+    assert events.index("eject") < events.index("drain_start")
+    with open(tmp_path / "run_summary.json") as f:
+        assert json.load(f)["routing"] == summary["routing"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end over a router (real socket, real clock)
+# ---------------------------------------------------------------------------
+
+def test_http_router_statz_and_streaming(bundle, offline):
+    import http.client
+    import threading
+    import time
+
+    from mmlspark_tpu.serve.lifecycle import start_http, stop_http
+
+    router = make_fleet(bundle, None)   # real clock: real HTTP latencies
+    server = start_http(router, port=0)
+    port = server.server_address[1]
+    # pace the scheduler ourselves: a pause after every productive tick
+    # spaces segment boundaries apart so the streamed chunks are
+    # deterministically distinct flushes, not a coalesced burst
+    stop_ticking = threading.Event()
+
+    def ticker():
+        while not stop_ticking.is_set():
+            time.sleep(0.03 if router._tick() else 0.005)
+
+    tick_thread = threading.Thread(target=ticker, daemon=True)
+    tick_thread.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/statz")
+        resp = conn.getresponse()
+        stats = json.loads(resp.read().decode())
+        assert resp.status == 200
+        assert set(stats["replicas"]) == {"r0", "r1"}
+        assert stats["replicas"]["r0"]["breaker"]["state"] == "closed"
+
+        prompt = np.random.default_rng(3).integers(
+            0, 64, (5,)).astype(np.int32)
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt": prompt.tolist(),
+                                 "max_new_tokens": 12, "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "application/x-ndjson"
+        t0 = time.monotonic()
+        first_token_at = done_at = None
+        streamed, chunks, final = [], 0, None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            payload = json.loads(line.decode())
+            if payload.get("restart"):
+                streamed = []
+            elif "tokens" in payload and not payload.get("done"):
+                chunks += 1
+                if first_token_at is None:
+                    first_token_at = time.monotonic() - t0
+                streamed.extend(payload["tokens"])
+            if payload.get("done"):
+                done_at = time.monotonic() - t0
+                final = payload
+                break
+        assert final is not None and final["status"] == "ok"
+        # segment-boundary flushes: tokens arrive in >= 2 chunks, and
+        # the first token lands strictly before the full response
+        assert chunks >= 2
+        assert first_token_at is not None and done_at is not None
+        assert first_token_at < done_at
+        assert streamed == final["tokens"]
+        assert final["tokens"] == offline(prompt, 12)
+        conn.close()
+    finally:
+        stop_http(server)
+        stop_ticking.set()
+        tick_thread.join(timeout=5)
+        router.stop()

@@ -485,3 +485,206 @@ def test_http_front_end_end_to_end(bundle, offline):
         stop_http(server)
         engine.stop()
     assert engine.state == "stopped"
+
+
+# ---------------------------------------------------------------------------
+# admission cold start + estimator convergence
+# ---------------------------------------------------------------------------
+
+def test_admission_cold_start_admits_without_evidence():
+    """First contact: no EWMA evidence exists, so `service_s` is None and
+    admission must NOT reject on feasibility — shedding needs proof."""
+    clock = VirtualClock()
+    est = StepTimeEstimator(alpha=0.3)
+    ac = AdmissionController(8, est, None, max_batch=4, clock=clock)
+    assert est.service_s(8, 12) is None
+    assert est.step_s(8) is None
+    # an absurdly tight deadline would be provably infeasible IF we had
+    # an estimate; cold, it sails through on the no-proof rule
+    now = clock.monotonic()
+    req = Request(1, np.zeros(5, np.int32), 8, 12, now, now + 1e-3)
+    assert ac.try_admit(req, 0) == "primary"
+    assert ac.pending() == 1
+
+
+def test_estimator_converges_then_admission_uses_proof():
+    clock = VirtualClock()
+    est = StepTimeEstimator(alpha=0.3)
+    ac = AdmissionController(8, est, None, max_batch=4, clock=clock)
+    # evidence arrives skewed (one slow outlier), then settles: the EWMA
+    # must converge to the steady value within K folds
+    est.observe_prefill(8, 1.0)
+    est.observe_step(8, 1.0)
+    K = 12
+    for _ in range(K):
+        est.observe_step(8, 0.1)
+        est.observe_prefill(8, 0.1)
+    assert est.step_s(8) == pytest.approx(0.1, abs=0.02)
+    # with proof in hand, the same tight deadline IS refused
+    now = clock.monotonic()
+    req = Request(2, np.zeros(5, np.int32), 8, 12, now, now + 1e-3)
+    with pytest.raises(Overloaded) as exc:
+        ac.try_admit(req, 0)
+    assert exc.value.reason == "infeasible"
+    # and a feasible one still lands
+    req = Request(3, np.zeros(5, np.int32), 8, 12, now, now + 60.0)
+    assert ac.try_admit(req, 0) == "primary"
+
+
+# ---------------------------------------------------------------------------
+# Retry-After headers (429 + 503)
+# ---------------------------------------------------------------------------
+
+def test_retry_after_headers_on_429_and_503():
+    """Pin the error contract: every shed/cancel response carries a
+    numeric Retry-After derived from live evidence.  A duck-typed stub
+    engine (http.py's serving surface) makes each refusal deterministic
+    instead of racing a real scheduler into the right state."""
+    import http.client
+    import time
+    import types
+
+    from mmlspark_tpu.serve.lifecycle import start_http, stop_http
+    from mmlspark_tpu.serve.request import CANCELLED
+    from mmlspark_tpu.serve.router import SHED, RouterRequest
+
+    class StubEngine:
+        state = "ready"
+        ready = True
+        cfg = types.SimpleNamespace(drain_timeout_s=1.0)
+
+        def __init__(self):
+            self.mode = "ok"
+
+        def now(self):
+            return time.monotonic()
+
+        def retry_after_s(self):
+            return 7.5            # the drain hint the 503 must carry
+
+        def stats(self):
+            return {"state": self.state}
+
+        def submit(self, prompt, max_new_tokens=None, deadline_s=None):
+            now = self.now()
+            if self.mode == "front_door_shed":
+                raise Overloaded("queue_full", 3.25, "queue at capacity")
+            rr = RouterRequest(1, np.asarray(prompt, np.int32), 8,
+                               int(max_new_tokens or 4), now, now + 5.0)
+            if self.mode == "drain_cancel":
+                rr.finish(CANCELLED, now, "engine draining")
+            elif self.mode == "budget_shed":
+                rr.retry_after_s = 2.5
+                rr.finish(SHED, now, "retry budget exhausted")
+            return rr
+
+    stub = StubEngine()
+    server = start_http(stub, port=0)
+    port = server.server_address[1]
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+
+        def post():
+            conn.request("POST", "/generate",
+                         json.dumps({"prompt": [1, 2, 3]}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp, json.loads(resp.read().decode())
+
+        # front-door shed: 429 + Retry-After from Overloaded's hint
+        stub.mode = "front_door_shed"
+        resp, body = post()
+        assert resp.status == 429
+        assert body["reason"] == "queue_full"
+        assert float(resp.getheader("Retry-After")) == pytest.approx(3.25)
+
+        # post-admission retry-budget shed: same 429 contract, hint from
+        # the request's own backoff field
+        stub.mode = "budget_shed"
+        resp, body = post()
+        assert resp.status == 429
+        assert body["reason"] == "retry_budget"
+        assert float(resp.getheader("Retry-After")) == pytest.approx(2.5)
+
+        # drain cancellation: 503 + Retry-After from the engine's live
+        # remaining-drain estimate
+        stub.mode = "drain_cancel"
+        resp, body = post()
+        assert resp.status == 503
+        assert "error" in body
+        assert float(resp.getheader("Retry-After")) == pytest.approx(7.5)
+        conn.close()
+    finally:
+        stop_http(server)
+
+
+# ---------------------------------------------------------------------------
+# streaming token responses
+# ---------------------------------------------------------------------------
+
+def test_streaming_flushes_at_segment_boundaries(bundle, offline):
+    """Chunked NDJSON over a real engine: tokens arrive in multiple
+    segment-boundary flushes, the first token lands strictly before the
+    full response, and the concatenated stream equals the authoritative
+    final tokens equals the offline oracle."""
+    import http.client
+    import threading
+    import time
+
+    from mmlspark_tpu.serve.lifecycle import start_http, stop_http
+
+    engine = make_engine(bundle, None)  # real clock: HTTP rides threads
+    engine.warmup()
+    server = start_http(engine, port=0)
+    port = server.server_address[1]
+    # pace the scheduler: a pause after every productive tick spaces the
+    # segment boundaries apart, so flushes are deterministically distinct
+    stop_ticking = threading.Event()
+
+    def ticker():
+        while not stop_ticking.is_set():
+            time.sleep(0.03 if engine._tick() else 0.005)
+
+    tick_thread = threading.Thread(target=ticker, daemon=True)
+    tick_thread.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        prompt = np.random.default_rng(21).integers(
+            0, 64, (5,)).astype(np.int32)
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt": prompt.tolist(),
+                                 "max_new_tokens": 12, "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "application/x-ndjson"
+        t0 = time.monotonic()
+        first_token_at = done_at = None
+        streamed, chunks, final = [], 0, None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            payload = json.loads(line.decode())
+            if "tokens" in payload and not payload.get("done"):
+                chunks += 1
+                if first_token_at is None:
+                    first_token_at = time.monotonic() - t0
+                streamed.extend(payload["tokens"])
+            if payload.get("done"):
+                done_at = time.monotonic() - t0
+                final = payload
+                break
+        assert final is not None and final["status"] == "ok"
+        assert final["restarts"] == 0   # single engine never fails over
+        assert chunks >= 2
+        assert first_token_at is not None and done_at is not None
+        assert first_token_at < done_at
+        assert streamed == final["tokens"]
+        assert final["tokens"] == offline(prompt, 12)
+        conn.close()
+    finally:
+        stop_http(server)
+        stop_ticking.set()
+        tick_thread.join(timeout=5)
+        engine.stop()

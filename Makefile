@@ -1,6 +1,6 @@
 # Developer entry points (the reference's `runme` + sbt targets,
 # tools/runme/runme.sh:30-52 + src/project/build.scala).
-.PHONY: check check-full test test-full lint bench bench-smoke bench-history chaos-drill serve-drill router-drill tpu-floors install docs notebooks clean
+.PHONY: check check-full test test-full lint bench bench-smoke bench-history chaos-drill serve-drill router-drill data-drill tpu-floors install docs notebooks clean
 
 check:            ## full gate: syntax + lint + suite + dryrun + bench smoke
 	bash scripts/check.sh
@@ -37,6 +37,9 @@ serve-drill:      ## serving chaos scenarios: burst shed, hung client, poison re
 
 router-drill:     ## replica chaos scenarios: crash failover, hang ejection, retry-budget shed, flap re-admission (scripts/router_drill.py)
 	python scripts/router_drill.py
+
+data-drill:       ## data-service chaos scenarios: worker crash re-dispatch, dynamic exactly-once, slow-worker load shift, fleet respawn (scripts/data_drill.py)
+	python scripts/data_drill.py
 
 tpu-floors:       ## throughput/MFU floors on a real TPU chip
 	MMLSPARK_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_perf_floor.py -q

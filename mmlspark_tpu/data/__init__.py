@@ -1,9 +1,12 @@
 """Streaming data layer: composable Dataset graphs with parallel map
-workers, bounded prefetch buffers, and span-driven autotuning.
+workers, bounded prefetch buffers, span-driven autotuning, and a
+disaggregated multi-process data service.
 
 See data/dataset.py for the graph model, data/autotune.py for the
 controller, data/executor.py for the one sanctioned thread-pool
-construction point, and docs/performance.md ("Streaming data layer").
+construction point, data/graph.py for the serialized graph spec,
+data/service/ for the worker tier (docs/data-service.md), and
+docs/performance.md ("Streaming data layer").
 """
 
 from mmlspark_tpu.data.autotune import Autotuner

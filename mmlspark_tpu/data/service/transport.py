@@ -1,0 +1,174 @@
+"""The data service's ONLY transport module: sockets + worker spawn.
+
+Everything that touches a raw `socket` or `subprocess` in this package
+lives here (lint-enforced, mirroring `serve/lifecycle.py` for threads
+and `data/executor.py` for pools), so the wire format and process
+lifecycle have exactly one implementation to audit.
+
+Wire format — length-prefixed frames on a localhost TCP stream:
+
+    [4B big-endian length][1B type][payload]
+
+Type ``J``: a JSON control message `{"t": kind, ...}` — handshake
+(`hello`), plan delivery (`graph`), work assignment (`split`),
+completion (`split_end` with element count + stage stats), worker-side
+failure (`err`), shutdown (`stop`).  Type ``E``: one ready element —
+`[4B split][4B seq]` + pickled payload, the hot path.  Sequence
+numbers are per-(split, attempt), which is what lets the dispatcher
+deduplicate redelivered elements after a crash re-dispatch.
+
+Reads on the dispatcher side are non-blocking (`recv_ready` +
+`FrameBuffer`) so one consumer thread can pump every worker; writes
+are small control frames sent blocking.  Workers use plain blocking
+sockets.  `connect` retries under the shared `RetryPolicy` and records
+per-worker circuit-breaker outcomes at the call site.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+from typing import Iterator, Optional
+
+_HDR = struct.Struct(">IB")
+_ELEM = struct.Struct(">II")
+_TYPE_JSON = 0x4A   # 'J'
+_TYPE_ELEM = 0x45   # 'E'
+_MAX_FRAME = 1 << 31
+
+
+class TransportError(ConnectionError):
+    """Framing/peer failure on a service connection (retryable class:
+    subclasses ConnectionError so `default_classify` retries it)."""
+
+
+def send_json(sock: socket.socket, msg: dict) -> None:
+    import json
+    payload = json.dumps(msg, sort_keys=True).encode("utf-8")
+    sock.sendall(_HDR.pack(len(payload) + 1, _TYPE_JSON) + payload)
+
+
+def send_elem(sock: socket.socket, split: int, seq: int, obj) -> None:
+    payload = _ELEM.pack(split, seq) + pickle.dumps(
+        obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload) + 1, _TYPE_ELEM) + payload)
+
+
+class FrameBuffer:
+    """Incremental frame parser: `feed` raw bytes, iterate `frames()`.
+    Frames come out as ("json", dict) or ("elem", split, seq, obj)."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> None:
+        self._buf.extend(data)
+
+    def frames(self) -> Iterator[tuple]:
+        import json
+        while True:
+            if len(self._buf) < _HDR.size:
+                return
+            length, ftype = _HDR.unpack_from(self._buf)
+            if not (1 <= length <= _MAX_FRAME):
+                raise TransportError(f"bad frame length {length}")
+            end = _HDR.size + length - 1
+            if len(self._buf) < end:
+                return
+            payload = bytes(self._buf[_HDR.size:end])
+            del self._buf[:end]
+            if ftype == _TYPE_JSON:
+                yield ("json", json.loads(payload.decode("utf-8")))
+            elif ftype == _TYPE_ELEM:
+                split, seq = _ELEM.unpack_from(payload)
+                yield ("elem", split, seq,
+                       pickle.loads(payload[_ELEM.size:]))
+            else:
+                raise TransportError(f"unknown frame type {ftype:#x}")
+
+
+def listen(host: str = "127.0.0.1") -> tuple[socket.socket, int]:
+    """Bind an ephemeral dispatcher port; returns (server_sock, port)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, 0))
+    srv.listen(64)
+    return srv, srv.getsockname()[1]
+
+
+def accept(server: socket.socket,
+           timeout_s: float) -> Optional[socket.socket]:
+    """Accept one worker connection (None on timeout).  Accepted conns
+    come back non-blocking with NODELAY — dispatcher pump sockets."""
+    server.settimeout(timeout_s)
+    try:
+        conn, _ = server.accept()
+    except (socket.timeout, BlockingIOError, InterruptedError):
+        return None
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    conn.setblocking(False)
+    return conn
+
+
+def connect(host: str, port: int, timeout_s: float = 10.0) -> socket.socket:
+    """Worker-side blocking connect (one attempt; callers wrap in the
+    shared RetryPolicy)."""
+    try:
+        sock = socket.create_connection((host, port), timeout=timeout_s)
+    except OSError as e:
+        raise TransportError(f"connect {host}:{port} failed: {e}") from e
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+    return sock
+
+
+def recv_ready(sock: socket.socket) -> Optional[bytes]:
+    """Drain whatever is available on a non-blocking socket.  Returns
+    b"" when nothing is pending, bytes when data arrived, None when the
+    peer closed or reset (the caller marks the worker dead)."""
+    chunks = []
+    while True:
+        try:
+            data = sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            break
+        except OSError:
+            return None
+        if not data:
+            return None if not chunks else b"".join(chunks)
+        chunks.append(data)
+        if len(data) < (1 << 16):
+            break
+    return b"".join(chunks)
+
+
+def spawn_worker(worker_id: int, host: str, port: int, *,
+                 env: Optional[dict] = None) -> subprocess.Popen:
+    """Launch one service worker process that connects back to the
+    dispatcher.  Workers are always pinned to CPU JAX (they decode and
+    shape host data; they must never claim an accelerator)."""
+    from mmlspark_tpu import config
+    wenv = dict(os.environ)
+    wenv["JAX_PLATFORMS"] = "cpu"
+    # gauges emitted inside the worker get a per-worker namespace so N
+    # workers reporting into one metrics backend never collide
+    wenv["MMLSPARK_TPU_DATA_SERVICE_WORKER_NS"] = \
+        f"data.service.w{worker_id}"
+    wenv.update(env or {})
+    log_dir = str(config.get("MMLSPARK_TPU_DATA_SERVICE_WORKER_LOG")
+                  or "")
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        stderr = open(os.path.join(log_dir, f"worker-{worker_id}.log"),
+                      "ab")
+    else:
+        stderr = subprocess.DEVNULL
+    return subprocess.Popen(
+        [sys.executable, "-m", "mmlspark_tpu.data.service.worker",
+         "--connect", f"{host}:{port}", "--id", str(worker_id)],
+        stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+        stderr=stderr, env=wenv)

@@ -171,6 +171,16 @@ def read_images(path: str, recursive: bool = False, sample_ratio: float = 1.0,
     return table
 
 
+def service_decode_chunk(chunk: list) -> tuple:
+    """Decode one `(path, bytes)` chunk to `([paths], [arrays|None])` —
+    the module-level (graph-serializable) form of the decode stage, so
+    `read_images_iter(service=...)` can ship it to data-service workers
+    by import reference (data/graph.py).  Per-row `on_error` policy is
+    NOT applied here: it stays on the consumer thread (`absorb`), so
+    failures surface in row order whichever process decoded them."""
+    return [p for p, _ in chunk], decode_many([b for _, b in chunk])
+
+
 def _dense_batch(paths: list, images: list,
                  errors: Optional[list] = None) -> DataTable:
     arr = np.stack(images)
@@ -189,7 +199,9 @@ def read_images_iter(path: str, batch_size: int = 256,
                      drop_failures: bool = True,
                      pattern: Optional[str] = None,
                      seed: int = 0,
-                     on_error: Optional[str] = None) -> Iterator[DataTable]:
+                     on_error: Optional[str] = None,
+                     service=None,
+                     deterministic: bool = True) -> Iterator[DataTable]:
     """Stream a directory/glob/zip of images as dense fixed-shape batches.
 
     The out-of-core face of `read_images` (reference streams partitions,
@@ -210,6 +222,13 @@ def read_images_iter(path: str, batch_size: int = 256,
     the one streaming caveat that "column" without resize_to needs a
     decodable image (or resize_to) before the first failure, since the
     placeholder must match the stream's fixed shape.
+
+    `service` splices the disaggregated data service into the decode
+    path: pass a `data.service.DataService` and the read+decode graph
+    executes on its worker processes (`Dataset.distribute`), while
+    per-row policy, resize, and batch assembly stay on the consumer.
+    `deterministic=True` (default) keeps batch order byte-identical to
+    local execution; False takes first-come dynamic sharding.
     """
     policy = _resolve_on_error(on_error, drop_failures)
     if batch_size <= 0:
@@ -281,14 +300,23 @@ def read_images_iter(path: str, batch_size: int = 256,
     # batches plus the accumulation buffer, so corpora stay unbounded by
     # host RAM.  The depth knob (MMLSPARK_TPU_PREFETCH_DEPTH) pins the
     # lookahead when positive and hands it to the Autotuner when 0.
-    staged = (Dataset
-              .from_files(path, recursive=recursive,
-                          sample_ratio=sample_ratio,
-                          inspect_zip=inspect_zip, pattern=pattern,
-                          seed=seed)
-              .batch(batch_size)
-              .map(decode_batch, name="decode", span=None)
-              .iterator())
+    source = Dataset.from_files(path, recursive=recursive,
+                                sample_ratio=sample_ratio,
+                                inspect_zip=inspect_zip, pattern=pattern,
+                                seed=seed).batch(batch_size)
+    if service is not None:
+        # service path: the serializable module-level decode fn replaces
+        # the span-instrumented closure (workers can't see this run's
+        # timings contextvar anyway) and the graph below this point runs
+        # on the service's worker processes
+        staged = (source
+                  .map(service_decode_chunk, name="decode", span=None)
+                  .distribute(service, deterministic=deterministic)
+                  .iterator())
+    else:
+        staged = (source
+                  .map(decode_batch, name="decode", span=None)
+                  .iterator())
     try:
         for batch_paths, decoded in staged:
             absorb(batch_paths, decoded)

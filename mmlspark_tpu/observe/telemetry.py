@@ -100,6 +100,10 @@ class RunTelemetry:
         # failover, ejection, probe re-admission — what the router drills
         # assert their failover/ejection sequences against
         self._routing: list[dict] = []
+        # the run's data-service timeline (data/service/dispatcher.py):
+        # split dispatch/completion, worker death, re-dispatch, scaling —
+        # what the data drill asserts its recovery invariants against
+        self._data_service: list[dict] = []
         # bounded-time cleanups run at finish() (e.g. stopping a metrics
         # server bound to this run) — never allowed to raise or hang the
         # run exit
@@ -235,6 +239,20 @@ class RunTelemetry:
         self.tracer._record({"type": "routing",
                              "ts": round(self.tracer.now(), 6), **rec})
 
+    def record_data_service(self, event: dict) -> None:
+        """Append one data-service event (data/service/dispatcher.py) to
+        the run's ordered timeline (also streamed as a `data_service`
+        record); the full list lands in run_summary.json under
+        `data_service` — every dispatch, split completion, worker death,
+        re-dispatch, scale decision, and snapshot resume — what the data
+        drill asserts its no-duplicate/no-drop recovery against."""
+        if not self.live:
+            return
+        rec = dict(event)
+        self._data_service.append(rec)
+        self.tracer._record({"type": "data_service",
+                             "ts": round(self.tracer.now(), 6), **rec})
+
     # -- finalizers --------------------------------------------------------
     def add_finalizer(self, fn) -> None:
         """Register a cleanup to run at `finish()` (LIFO).  Finalizers
@@ -271,6 +289,7 @@ class RunTelemetry:
             "recovery": [dict(e) for e in self._recovery],
             "serve": [dict(e) for e in self._serve],
             "routing": [dict(e) for e in self._routing],
+            "data_service": [dict(e) for e in self._data_service],
             "trace_records_dropped": self.tracer.dropped,
         }
 

@@ -57,6 +57,15 @@ PREFETCH_WORKERS = config.register(
     doc="Staging thread-pool width per prefetcher (clamped to the depth); "
         "threads run host featurize/pad work and the device_put transfer.")
 
+PREFETCH_WORKER_NS = config.register(
+    "MMLSPARK_TPU_DATA_SERVICE_WORKER_NS", default=None,
+    doc="Gauge namespace prefix for Prefetcher stages running inside a "
+        "data-service worker (set per process by the dispatcher at "
+        "spawn, e.g. 'data.service.w3'): stage gauges publish as "
+        "'<ns>.<stage>.depth' instead of 'prefetch.<stage>.depth', so "
+        "N workers reporting into one metrics backend never collide. "
+        "Unset: the in-process 'prefetch.' namespace.")
+
 # The autotuner's floor: an autotuned stage starts here and is never
 # narrowed below it, so "autotune" always keeps at least double buffering.
 DEPTH_FLOOR = 2
@@ -130,6 +139,11 @@ class Prefetcher:
         # pipeline failing to hide host/transfer work).
         from mmlspark_tpu.observe.telemetry import active_run
         self._run = active_run()
+        # inside a data-service worker, gauges carry the per-worker
+        # namespace the dispatcher assigned (data.service.w<k>.<stage>)
+        # so fleet members never collide on one metrics backend
+        ns = config.get("MMLSPARK_TPU_DATA_SERVICE_WORKER_NS")
+        self._gauge_ns = f"{ns}.{name}" if ns else f"prefetch.{name}"
         # always-on counters (cheap: one perf_counter pair per stalled
         # pull) — the data-layer Autotuner reads these via `stats()` even
         # when no telemetry run is active
@@ -199,9 +213,9 @@ class Prefetcher:
             self.deliveries += 1
             self.residency += len(self._pending)
             if self._run is not None:
-                self._run.gauge(f"prefetch.{self._name}.depth",
+                self._run.gauge(f"{self._gauge_ns}.depth",
                                 len(self._pending))
-                self._run.gauge(f"prefetch.{self._name}.stall_s",
+                self._run.gauge(f"{self._gauge_ns}.stall_s",
                                 round(self.stall_s, 6))
             self._top_up()  # refill the window before handing control back
             return result

@@ -133,6 +133,15 @@ class Fault:
     'replica_flap' (crash now, recover after `seconds` — the half-open
     probe re-admission drill), 'replica_slow' (tick throttled by
     `factor` — stays routable until miss evidence ejects it).
+
+    Data-service faults (interpreted by the service worker drivers,
+    data/service/dispatcher.py, during the data drill
+    scripts/data_drill.py): they act on service worker number `worker`
+    once that worker has produced `at_elem` elements —
+    'worker_crash' (the worker dies with its split unacked; the
+    dispatcher must re-dispatch it with no duplicated or dropped rows),
+    'worker_slow' (the worker's production throttled by `factor` — the
+    stall-evidence autoscaling drill).
     """
 
     kind: str
@@ -144,15 +153,20 @@ class Fault:
     at_request: int = 1      # serving faults: workload request index (1-based)
     size: int = 8            # burst: how many extra arrivals to inject
     replica: int = 0         # replica faults: fleet position (0-based)
-    factor: float = 4.0      # replica_slow: tick-throttle factor
+    factor: float = 4.0      # replica_slow / worker_slow: throttle factor
+    worker: int = 0          # data faults: service worker id (0-based)
+    at_elem: int = 0         # data faults: fire once the worker has
+    #                          produced this many elements
 
     _KINDS = ("nan", "sigterm", "hang", "tear",
               "burst", "slow_client", "poison",
               "replica_crash", "replica_hang", "replica_flap",
-              "replica_slow")
+              "replica_slow",
+              "worker_crash", "worker_slow")
     _SERVE_KINDS = ("burst", "slow_client", "poison")
     _REPLICA_KINDS = ("replica_crash", "replica_hang", "replica_flap",
                       "replica_slow")
+    _DATA_KINDS = ("worker_crash", "worker_slow")
     _TARGETS = ("payload", "sidecar", "latest")
 
     def __post_init__(self):
@@ -357,6 +371,41 @@ class ChaosInjector:
                 inc_counter(f"chaos.{f.kind}")
                 trace_event(f"chaos.{f.kind}", cat="resilience",
                             request_index=request_index, replica=f.replica)
+                due.append(f)
+        return due
+
+    # -- data-service hazards ----------------------------------------------
+    def data_faults_due(self, worker: int, produced: int) -> list:
+        """The unfired scripted data-service faults due for service
+        `worker` once it has produced `produced` elements, each fired at
+        most once.  The inproc worker driver consults this between
+        elements and acts the fault out (die with the split unacked /
+        throttle production); the dispatcher under test only sees the
+        resulting failure."""
+        due = []
+        for i, f in enumerate(self.script):
+            if f.kind in Fault._DATA_KINDS and i not in self._fired \
+                    and f.worker == worker and produced >= f.at_elem:
+                self._fired.add(i)
+                inc_counter(f"chaos.{f.kind}")
+                trace_event(f"chaos.{f.kind}", cat="resilience",
+                            worker=worker, produced=produced)
+                due.append(f)
+        return due
+
+    def data_faults_for(self, worker: int) -> list:
+        """All unfired data-service faults targeting `worker`, marked
+        fired — the process-mode path, where the dispatcher folds them
+        into the spawned worker's environment and the fault plays out
+        in that process."""
+        due = []
+        for i, f in enumerate(self.script):
+            if f.kind in Fault._DATA_KINDS and i not in self._fired \
+                    and f.worker == worker:
+                self._fired.add(i)
+                inc_counter(f"chaos.{f.kind}")
+                trace_event(f"chaos.{f.kind}", cat="resilience",
+                            worker=worker)
                 due.append(f)
         return due
 

@@ -489,6 +489,12 @@ class Trainer:
         # = no adjustment, pre-meta checkpoints keep restoring).
         if resume and ckpt_dir and is_coordinator():
             saved = checkpoint_meta(latest_valid_checkpoint(ckpt_dir)) or {}
+            # mid-epoch data position saved by snapshot() ops: arm the
+            # restore registry so the NEXT build of each tagged pipeline
+            # fast-forwards past the already-consumed prefix
+            if saved.get("data_snapshots"):
+                from mmlspark_tpu.data.snapshot import set_restore_offsets
+                set_restore_offsets(saved["data_snapshots"])
             saved_bs = int(saved.get("effective_batch_size") or 0)
             saved_dp = int(saved.get("data_devices") or 0)
             if saved_dp and saved_dp != data_size:
@@ -955,7 +961,7 @@ class Trainer:
         """The elastic-resume meta sidecar: the topology and EFFECTIVE
         batch size this checkpoint was written under, so a resume onto a
         different device count can replay the identical data order."""
-        return {
+        meta = {
             "step": int(step),
             "data_devices": int(self.mesh.shape.get(DATA_AXIS, 1)),
             "model_devices": int(self.mesh.shape.get(MODEL_AXIS, 1)),
@@ -965,6 +971,14 @@ class Trainer:
             "rng_fold": int(self.config.rng_fold),
             "format": 1,
         }
+        # mid-epoch data position: every live snapshot() op's consumed
+        # count rides the sidecar, so a resume replays exactly the
+        # remaining elements (data/snapshot.py; docs/data-service.md)
+        from mmlspark_tpu.data.snapshot import snapshot_offsets
+        offsets = snapshot_offsets()
+        if offsets:
+            meta["data_snapshots"] = offsets
+        return meta
 
     def save_checkpoint(self, state: TrainState, ckpt_dir: str, *,
                         step: Optional[int] = None,

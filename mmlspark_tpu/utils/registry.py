@@ -134,6 +134,7 @@ def _config_summary() -> list:
                 "mmlspark_tpu.observe.history",
                 "mmlspark_tpu.parallel.prefetch",
                 "mmlspark_tpu.data.autotune",
+                "mmlspark_tpu.data.service",
                 "mmlspark_tpu.io.remote",
                 "mmlspark_tpu.resilience.retry",
                 "mmlspark_tpu.resilience.breaker",

@@ -43,6 +43,9 @@ python scripts/serve_drill.py
 echo "== router drill (crash-failover / hang-eject / budget-shed / flap-readmit) =="
 python scripts/router_drill.py
 
+echo "== data drill (worker-crash redispatch / dynamic exactly-once / slow-worker shift / respawn) =="
+python scripts/data_drill.py
+
 echo "== bench smoke (JSON contract) =="
 python bench.py --smoke
 

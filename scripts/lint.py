@@ -46,6 +46,13 @@ Checks, per file:
     stage carries the Prefetcher counter/`set_depth` surface the
     Autotuner depends on, and "how many threads does ingestion own?"
     stays a one-file audit
+  * raw socket / subprocess construction inside `mmlspark_tpu/` outside
+    the data service's transport module (`data/service/transport.py`) —
+    worker-fleet plumbing (connect retries, frame encoding, spawn env)
+    lives behind one auditable seam so chaos hooks and the resilience
+    retry/breaker policies wrap EVERY byte on the wire;
+    `native_loader.py` is whitelisted (its one `subprocess.run` compiles
+    the optional native extension at import, pre-dating the service)
   * tabs in indentation
 """
 
@@ -107,6 +114,20 @@ DATA_EXECUTOR = os.path.join("mmlspark_tpu", "data", "executor.py")
 _POOL_CTOR_NAMES = ("ThreadPoolExecutor", "ProcessPoolExecutor", "Thread",
                     "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
                     "Prefetcher")
+
+# the data service: raw socket/subprocess construction anywhere in the
+# package outside the one transport module dodges the retry/breaker
+# policies and chaos hooks wrapping the worker wire protocol
+TRANSPORT_FILE = os.path.join("mmlspark_tpu", "data", "service",
+                              "transport.py")
+TRANSPORT_WHITELIST = {
+    TRANSPORT_FILE,
+    # pre-existing: one subprocess.run compiling the native extension
+    os.path.join("mmlspark_tpu", "native_loader.py"),
+}
+_SOCKET_CTOR_NAMES = ("create_connection", "create_server", "socketpair")
+_SUBPROCESS_CALL_NAMES = ("Popen", "run", "call", "check_call",
+                          "check_output", "getoutput", "getstatusoutput")
 
 # the framework package: raw print()/root-logger output is forbidden here
 # (route through observe.logging); the report CLI is the one whitelisted
@@ -229,6 +250,39 @@ def _is_thread_or_server_ctor(node: ast.Call) -> bool:
     return name == "Thread" or bool(name and name.endswith("HTTPServer"))
 
 
+def _in_transport_policy(path: str) -> bool:
+    norm = os.path.normpath(path)
+    return (norm.startswith(PACKAGE_DIR + os.sep)
+            and norm not in TRANSPORT_WHITELIST)
+
+
+def _is_raw_socket_ctor(node: ast.Call) -> bool:
+    """Matches `socket.socket(...)`, `socket.create_connection(...)` /
+    `create_server` / `socketpair` (module attribute or bare from-import
+    form) — the constructions transport.py owns exclusively."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id in _SOCKET_CTOR_NAMES
+    if not isinstance(fn, ast.Attribute):
+        return False
+    if isinstance(fn.value, ast.Name) and fn.value.id == "socket":
+        return fn.attr == "socket" or fn.attr in _SOCKET_CTOR_NAMES
+    return False
+
+
+def _is_raw_subprocess_call(node: ast.Call) -> bool:
+    """Matches `subprocess.Popen/run/call/check_*(...)` and a bare
+    `Popen(...)` from `from subprocess import Popen` (the bare `run` /
+    `call` forms are too name-collision-prone to flag)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "Popen"
+    return (isinstance(fn, ast.Attribute)
+            and fn.attr in _SUBPROCESS_CALL_NAMES
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "subprocess")
+
+
 def _in_package(path: str) -> bool:
     norm = os.path.normpath(path)
     return (norm.startswith(PACKAGE_DIR + os.sep)
@@ -314,7 +368,23 @@ def check_file(path: str) -> list[str]:
     in_train = _in_train(path)
     in_serve_policy = _in_serve_policy(path)
     in_data_policy = _in_data_policy(path)
+    in_transport_policy = _in_transport_policy(path)
     for node in ast.walk(tree):
+        if in_transport_policy and isinstance(node, ast.Call):
+            if _is_raw_socket_ctor(node):
+                problems.append(
+                    f"{path}:{node.lineno}: raw socket construction "
+                    f"inside mmlspark_tpu/ outside data/service/"
+                    f"transport.py — wire plumbing lives behind the one "
+                    f"transport seam (retry/breaker policies + chaos "
+                    f"hooks wrap every byte)")
+            if _is_raw_subprocess_call(node):
+                problems.append(
+                    f"{path}:{node.lineno}: raw subprocess call inside "
+                    f"mmlspark_tpu/ outside data/service/transport.py — "
+                    f"process spawning goes through transport."
+                    f"spawn_worker so worker env/log wiring stays "
+                    f"auditable in one file")
         if in_data_policy and isinstance(node, ast.Call) \
                 and _is_pool_ctor(node):
             problems.append(

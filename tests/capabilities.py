@@ -189,10 +189,46 @@ def _probe_package_installed():
     return None
 
 
+def _probe_data_service_workers():
+    """Spawn ONE real data-service worker subprocess and complete the
+    hello handshake over a localhost socket — the smallest program that
+    exercises what process-mode `Dataset.distribute()` needs (python
+    subprocess spawn + loopback TCP + the package importable in a fresh
+    interpreter).  Sandboxes that forbid either make the process-mode
+    tests skip here instead of hanging on accept()."""
+    from mmlspark_tpu.data.service import transport
+
+    srv, port = transport.listen()
+    proc = transport.spawn_worker(0, "127.0.0.1", port)
+    try:
+        conn = transport.accept(srv, timeout_s=60.0)
+        if conn is None:
+            return ("data-service worker subprocess never connected back "
+                    "over localhost (spawn or loopback TCP unavailable)")
+        conn.setblocking(True)
+        buf = transport.FrameBuffer()
+        while True:
+            data = conn.recv(65536)
+            if not data:
+                return ("data-service worker closed its socket before "
+                        "the hello frame")
+            buf.feed(data)
+            for frame in buf.frames():
+                if frame[0] == "json" and frame[1].get("t") == "hello":
+                    transport.send_json(conn, {"t": "stop"})
+                    conn.close()
+                    return None
+    finally:
+        srv.close()
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
 _PROBES = {
     "lax_pcast": _probe_lax_pcast,
     "shard_map_checkpoint_name": _probe_shard_map_checkpoint_name,
     "shard_map_pallas": _probe_shard_map_pallas,
     "multiprocess_collectives": _probe_multiprocess_collectives,
     "package_installed": _probe_package_installed,
+    "data_service_workers": _probe_data_service_workers,
 }

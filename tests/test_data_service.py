@@ -1,0 +1,443 @@
+"""Disaggregated data-service contracts: graph round-trips, split-range
+equivalence, deterministic sharding byte-equality, exactly-once crash
+recovery, snapshot/resume, worker autoscaling, and the per-worker gauge
+namespace — all inproc (cooperative workers pumped inline, no threads,
+no sleeps) except the marked process-mode tests, which spawn REAL worker
+subprocesses and skip where the environment cannot (requires_env).
+"""
+
+import json
+
+import pytest
+
+from mmlspark_tpu import config
+from mmlspark_tpu.data import Dataset, graph
+from mmlspark_tpu.data import snapshot as snapmod
+from mmlspark_tpu.data.graph import GraphSerializationError
+from mmlspark_tpu.observe.telemetry import run_telemetry
+from mmlspark_tpu.resilience.chaos import ChaosInjector, Fault, set_injector
+
+
+def _double(x):
+    return x * 2
+
+
+def _tens(x):
+    return Dataset.from_iterable([x * 10, x * 10 + 1])
+
+
+def _boom_on_seven(x):
+    if x == 7:
+        raise ValueError("boom")
+    return x
+
+
+@pytest.fixture(autouse=True)
+def _clean_snapshots():
+    snapmod.clear()
+    yield
+    snapmod.clear()
+
+
+def local(ds):
+    return [b for b in ds.iterator(autotune=False)]
+
+
+def batches(ds, **kw):
+    kw.setdefault("mode", "inproc")
+    it = ds.distribute(**kw).iterator(autotune=False)
+    with it:
+        return [b for b in it]
+
+
+# -- graph serialization -----------------------------------------------------
+
+def every_op_dataset():
+    """A plan touching every serializable op: both sources are covered
+    across tests (from_files rides the process-mode test)."""
+    return (Dataset.from_iterable(list(range(24)))
+            .map(_double, name="dbl", on_error="fail", span=None)
+            .shuffle(8, seed=11)
+            .interleave(_tens, cycle_length=2, block_length=1)
+            .skip(2).take(40)
+            .batch(4, drop_remainder=False)
+            .snapshot("rt")
+            .prefetch(2, name="pf"))
+
+
+def test_roundtrip_every_op_byte_exact():
+    ds = every_op_dataset()
+    text = graph.dumps(ds)
+    assert graph.dumps(graph.loads(text)) == text
+    # and the rebuilt plan yields the identical element sequence
+    assert [list(b) for b in local(graph.loads(text))] \
+        == [list(b) for b in local(ds)]
+
+
+@pytest.mark.parametrize("policy", ["fail", "skip", "column"])
+def test_roundtrip_on_error_policies(policy):
+    src = Dataset.from_iterable(list(range(12)))
+    if policy == "fail":
+        ds = src.map(_double, on_error=policy, span=None)
+    else:
+        ds = src.map(_boom_on_seven, on_error=policy, span=None)
+    text = graph.dumps(ds)
+    assert graph.dumps(graph.loads(text)) == text
+    spec = json.loads(text)
+    assert spec["root"]["params"]["on_error"] == policy
+
+
+def test_roundtrip_seeded_shuffle_replays():
+    ds = Dataset.from_iterable(list(range(50))).shuffle(16, seed=3)
+    rebuilt = graph.loads(graph.dumps(ds))
+    assert local(rebuilt) == local(ds)
+
+
+def test_lambda_rejected_at_serialize_time():
+    ds = Dataset.from_iterable([1, 2]).map(lambda x: x, span=None)
+    with pytest.raises(GraphSerializationError, match="lambda"):
+        graph.to_spec(ds)
+
+
+def test_registered_fn_roundtrips():
+    closure = graph.register_fn("test.data_service.plus3",
+                                lambda x: x + 3)
+    ds = Dataset.from_iterable([1, 2, 3]).map(closure, span=None)
+    rebuilt = graph.loads(graph.dumps(ds))
+    assert local(rebuilt) == [4, 5, 6]
+
+
+def test_from_table_not_serializable():
+    from mmlspark_tpu.core.table import DataTable
+    import numpy as np
+    ds = Dataset.from_table(DataTable({"a": np.arange(4)}))
+    with pytest.raises(GraphSerializationError, match="from_table"):
+        graph.to_spec(ds)
+
+
+def test_unknown_version_rejected():
+    spec = graph.to_spec(Dataset.from_iterable([1]))
+    spec["version"] = 999
+    with pytest.raises(GraphSerializationError, match="version"):
+        graph.from_spec(spec)
+
+
+@pytest.mark.parametrize("lo,hi", [(0, 3), (2, 7), (5, 5), (8, 20)])
+def test_build_range_matches_local_slice(lo, hi):
+    """A split is a pure function of (graph, range): building [lo, hi)
+    must equal slicing the full local output — including through the
+    pushed-down batch/map/prefetch ops above the barrier."""
+    ds = (Dataset.from_iterable(list(range(40))).shuffle(8, seed=5)
+          .map(_double, span=None).batch(3).prefetch(2))
+    spec = graph.to_spec(ds)
+    full = [list(b) for b in local(ds)]
+    got = [list(b) for b in
+           graph.build_range(spec, lo, hi, sync=True).iterator(
+               autotune=False)]
+    assert got == full[lo:hi]
+
+
+# -- deterministic / dynamic sharding ---------------------------------------
+
+def graph_ds():
+    return (Dataset.from_iterable(list(range(60)))
+            .shuffle(16, seed=7).map(_double, span=None).batch(5))
+
+
+def test_deterministic_mode_byte_identical_to_local():
+    ds = graph_ds()
+    want = [list(b) for b in local(ds)]
+    for workers in (1, 2, 3):
+        got = [list(b) for b in batches(graph_ds(), workers=workers,
+                                        split_elems=2)]
+        assert got == want, f"workers={workers} diverged"
+
+
+def test_dynamic_mode_exactly_once():
+    ds = graph_ds()
+    want = sorted(x for b in local(ds) for x in b)
+    got = [x for b in batches(graph_ds(), workers=3, deterministic=False,
+                              split_elems=1) for x in b]
+    assert sorted(got) == want
+
+
+def test_negative_workers_bypasses_service():
+    """workers < 0 mirrors the prefetch escape hatch: the distribute op
+    becomes a no-op passthrough (no fleet, no session)."""
+    ds = graph_ds()
+    it = ds.distribute(workers=-1).iterator(autotune=False)
+    with it:
+        assert it.stage("service") is None
+        assert [list(b) for b in it] == [list(b) for b in local(graph_ds())]
+
+
+# -- crash recovery (exactly-once) ------------------------------------------
+
+def _with_faults(faults):
+    return set_injector(ChaosInjector(script=faults))
+
+
+def test_inproc_crash_redispatches_and_stays_byte_identical():
+    want = [list(b) for b in local(graph_ds())]
+    prev = _with_faults([Fault(kind="worker_crash", worker=0, at_elem=4)])
+    try:
+        with run_telemetry(None) as rt:
+            got = [list(b) for b in batches(graph_ds(), workers=2,
+                                            split_elems=2)]
+    finally:
+        set_injector(prev)
+    assert got == want  # no dup, no drop, same order
+    kinds = [e["kind"] for e in rt.summary()["data_service"]]
+    assert "worker_dead" in kinds and "redispatch" in kinds
+    assert kinds.index("worker_dead") < kinds.index("redispatch")
+    end = [e for e in rt.summary()["data_service"]
+           if e["kind"] == "session_end"][-1]
+    assert end["delivered"] == len(want)
+    assert end["redispatches"] >= 1
+
+
+def test_inproc_crash_dynamic_exactly_once():
+    want = sorted(x for b in local(graph_ds()) for x in b)
+    prev = _with_faults([Fault(kind="worker_crash", worker=1, at_elem=3)])
+    try:
+        got = [x for b in batches(graph_ds(), workers=2,
+                                  deterministic=False, split_elems=1)
+               for x in b]
+    finally:
+        set_injector(prev)
+    assert sorted(got) == want
+    assert len(got) == len(set(tuple([g]) for g in range(len(got))))  # length sanity
+
+
+def test_single_worker_crash_respawns():
+    want = [list(b) for b in local(graph_ds())]
+    prev = _with_faults([Fault(kind="worker_crash", worker=0, at_elem=5)])
+    try:
+        with run_telemetry(None) as rt:
+            got = [list(b) for b in batches(graph_ds(), workers=1,
+                                            split_elems=2)]
+    finally:
+        set_injector(prev)
+    assert got == want
+    kinds = [e["kind"] for e in rt.summary()["data_service"]]
+    assert "respawn" in kinds
+
+
+def test_worker_slow_shifts_load_not_data():
+    want = [list(b) for b in local(graph_ds())]
+    prev = _with_faults([Fault(kind="worker_slow", worker=0, at_elem=0,
+                               factor=8.0)])
+    try:
+        with run_telemetry(None) as rt:
+            got = [list(b) for b in batches(graph_ds(), workers=2,
+                                            split_elems=1)]
+    finally:
+        set_injector(prev)
+    assert got == want
+    ends = [e for e in rt.summary()["data_service"]
+            if e["kind"] == "split_end"]
+    by_worker = {}
+    for e in ends:
+        by_worker[e["worker"]] = by_worker.get(e["worker"], 0) + 1
+    assert by_worker.get(0, 0) < sum(n for w, n in by_worker.items()
+                                     if w != 0)
+
+
+# -- mid-epoch snapshot / resume --------------------------------------------
+
+def snap_ds():
+    return (Dataset.from_iterable(list(range(60))).shuffle(8, seed=3)
+            .batch(4).distribute(workers=2, mode="inproc", split_elems=2)
+            .snapshot("train"))
+
+
+def test_snapshot_resume_replays_exact_remainder():
+    full = [list(b) for b in snap_ds().iterator(autotune=False)]
+    snapmod.clear()
+    it = snap_ds().iterator(autotune=False)
+    first = [list(next(it)) for _ in range(7)]
+    offsets = snapmod.snapshot_offsets()
+    it.close()
+    assert offsets == {"train": 7}
+    snapmod.set_restore_offsets(offsets)
+    rest = [list(b) for b in snap_ds().iterator(autotune=False)]
+    assert first + rest == full
+
+
+def test_snapshot_resume_fast_forward_never_produces_prefix():
+    """With snapshot directly above distribute, resume fast-forwards the
+    dispatch origin: the skipped prefix is never produced, which the
+    dispatch events' split ranges expose."""
+    snapmod.set_restore_offsets({"train": 7})
+    with run_telemetry(None) as rt:
+        rest = [list(b) for b in snap_ds().iterator(autotune=False)]
+    full = [list(b) for b in snap_ds().iterator(autotune=False)]
+    assert rest == full[7:]
+    events = rt.summary()["data_service"]
+    assert any(e["kind"] == "resume" and e.get("offset") == 7
+               for e in events)
+
+
+def test_snapshot_resume_islice_fallback():
+    """A snapshot NOT directly above the service still resumes exactly
+    (consumer-side drop of the consumed prefix)."""
+    def build():
+        return (Dataset.from_iterable(list(range(40))).batch(4)
+                .distribute(workers=2, mode="inproc")
+                .prefetch(-1).snapshot("t2"))
+    full = [list(b) for b in build().iterator(autotune=False)]
+    snapmod.clear()
+    it = build().iterator(autotune=False)
+    first = [list(next(it)) for _ in range(4)]
+    offsets = snapmod.snapshot_offsets()
+    it.close()
+    snapmod.set_restore_offsets(offsets)
+    rest = [list(b) for b in build().iterator(autotune=False)]
+    assert first + rest == full
+
+
+def test_snapshot_offsets_land_in_trainer_meta():
+    """The trainer's checkpoint meta sidecar carries every live
+    snapshot's consumed offset, and the resume path re-arms the restore
+    registry from a saved meta dict."""
+    import numpy as np
+    from mmlspark_tpu.train.trainer import Trainer, TrainerConfig
+
+    h = snapmod.register("train")
+    h.consumed = 13
+    trainer = Trainer.__new__(Trainer)  # meta needs mesh/config only
+    trainer.mesh = type("M", (), {"shape": {}})()
+    trainer.config = TrainerConfig(batch_size=8)
+    trainer._effective_batch_size = 8
+    import jax
+    meta = Trainer._ckpt_meta(trainer, 5)
+    assert meta["data_snapshots"] == {"train": 13}
+    # the restore half: a saved meta re-arms the registry
+    snapmod.clear()
+    snapmod.set_restore_offsets(meta["data_snapshots"])
+    assert snapmod.take_restore("train") == 13
+    assert snapmod.take_restore("train") == 0  # one-shot
+    del np, jax
+
+
+# -- autoscaling -------------------------------------------------------------
+
+def test_autotuner_scales_worker_fleet_from_stall_evidence():
+    """workers=0 = autoscale: the fleet starts at one worker and the
+    stock Autotuner widens it through the ServiceConsumer's depth
+    surface (scale_unit='workers'), never above MAX_WORKERS, never
+    below its depth_floor of 1."""
+    prev = config.get("MMLSPARK_TPU_DATA_AUTOTUNE_INTERVAL")
+    config.set("MMLSPARK_TPU_DATA_AUTOTUNE_INTERVAL", 8)
+    try:
+        it = (Dataset.from_iterable(list(range(200))).batch(2)
+              .distribute(workers=0, mode="inproc", split_elems=1)
+              .iterator())
+        with it:
+            out = [list(b) for b in it]
+        assert len(out) == 100
+        stage = it.stage("service")
+        assert stage is not None and stage.tunable
+        assert stage.runner.scale_unit == "workers"
+        assert stage.runner.depth_floor == 1
+        widened = [d for d in (it.tuner.decisions if it.tuner else [])
+                   if d["stage"] == "service" and d["action"] == "widen"]
+        assert widened, "no widen decision despite a stalling consumer"
+        assert all(d["unit"] == "workers" for d in widened)
+        assert stage.runner.depth > 1
+        assert stage.runner.depth <= stage.runner.max_depth
+    finally:
+        config.set("MMLSPARK_TPU_DATA_AUTOTUNE_INTERVAL", prev)
+
+
+def test_service_consumer_scale_clamped():
+    from mmlspark_tpu.data.service import DataService
+    from mmlspark_tpu.data.service.consume import ServiceConsumer
+    svc = DataService(workers=2, mode="inproc", max_workers=3)
+    spec = graph.to_spec(Dataset.from_iterable(list(range(8))))
+    consumer = ServiceConsumer(svc, spec)
+    try:
+        assert consumer.depth == 2
+        assert consumer.max_depth == 3
+        assert consumer.set_depth(99) == 3
+        assert consumer.set_depth(0) == 1  # floor: one worker
+        stats = consumer.stats()
+        assert {"deliveries", "stalls", "stall_s",
+                "residency"} <= set(stats)
+    finally:
+        consumer.close()
+
+
+# -- per-worker gauge namespace ---------------------------------------------
+
+def test_prefetcher_gauges_use_worker_namespace():
+    """Inside a service worker (namespace config set), Prefetcher stage
+    gauges publish under data.service.w<k>.<stage>.* instead of
+    prefetch.<stage>.* — N workers never collide on one backend."""
+    from mmlspark_tpu.parallel.prefetch import Prefetcher
+    config.set("MMLSPARK_TPU_DATA_SERVICE_WORKER_NS", "data.service.w3")
+    try:
+        with run_telemetry(None) as rt:
+            with Prefetcher(lambda x: x, range(6), depth=2,
+                            name="decode") as pf:
+                list(pf)
+        gauges = rt.summary()["gauges"]
+    finally:
+        config.set("MMLSPARK_TPU_DATA_SERVICE_WORKER_NS", None)
+    assert "data.service.w3.decode.depth" in gauges
+    assert not any(k.startswith("prefetch.decode") for k in gauges)
+    # and unset, the in-process namespace is unchanged
+    with run_telemetry(None) as rt:
+        with Prefetcher(lambda x: x, range(6), depth=2,
+                        name="decode") as pf:
+            list(pf)
+    assert "prefetch.decode.depth" in rt.summary()["gauges"]
+
+
+def test_dispatcher_publishes_per_worker_gauges():
+    with run_telemetry(None) as rt:
+        got = [list(b) for b in batches(graph_ds(), workers=2,
+                                        split_elems=1)]
+    assert got == [list(b) for b in local(graph_ds())]
+    gauges = rt.summary()["gauges"]
+    produced = {k: v["last"] for k, v in gauges.items()
+                if k.startswith("data.service.w") and
+                k.endswith(".produced")}
+    assert len(produced) == 2, gauges.keys()
+    assert sum(int(v) for v in produced.values()) >= 12  # every batch
+
+
+# -- process mode (real worker subprocesses) --------------------------------
+
+@pytest.mark.requires_env("data_service_workers")
+def test_process_mode_deterministic_matches_local():
+    ds = (Dataset.from_iterable(list(range(40))).shuffle(8, seed=2)
+          .batch(4))
+    want = [list(b) for b in local(ds)]
+    got = [list(b) for b in batches(ds, workers=2, mode="process")]
+    assert got == want
+
+
+@pytest.mark.requires_env("data_service_workers")
+def test_process_mode_images_via_read_images_iter(tmp_path):
+    """End-to-end transparency: read_images_iter consumes the service
+    with no caller-visible change — same tables, same order."""
+    import numpy as np
+    from PIL import Image
+
+    from mmlspark_tpu.data.service import DataService
+    from mmlspark_tpu.io.image_reader import read_images_iter
+
+    for i in range(12):
+        arr = np.full((8, 8, 3), i * 3, dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img_{i:02d}.png")
+
+    local_tables = list(read_images_iter(str(tmp_path), batch_size=5))
+    svc = DataService(workers=2, mode="process", split_elems=1)
+    svc_tables = list(read_images_iter(str(tmp_path), batch_size=5,
+                                       service=svc))
+    assert len(svc_tables) == len(local_tables)
+    for a, b in zip(svc_tables, local_tables):
+        assert list(a["path"]) == list(b["path"])
+        np.testing.assert_array_equal(np.asarray(a["image"]),
+                                      np.asarray(b["image"]))

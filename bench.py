@@ -1035,6 +1035,208 @@ def bench_lm_decode(smoke: bool) -> dict:
     }
 
 
+def bench_lm_tensor_parallel(smoke: bool) -> dict:
+    """Tensor-parallel (mp=2) arms (parallel/partition.py registry).
+
+    1. RULE/GATHER PIN (any device count, CPU smoke included): the
+       Megatron split the regex registry assigns (qkv/up column-parallel,
+       proj/down row-parallel) and a shard -> gather round-trip on a 1x1
+       mesh — byte-identical full-shape arrays back.  These pin the
+       registry's semantics every round even where 1 chip is all there is.
+    2. TRAIN: the SAME TransformerLM step on a dp-only mesh vs a
+       dp x mp=2 mesh over the same devices and the same global batch —
+       per-chip tokens/sec for both and their ratio.  The ~85% target
+       (docs/performance.md) is what the extra all-reduces may cost when
+       the model FITS at dp-only; the arm exists for when it doesn't.
+    3. DECODE: greedy generation through TextGenerator.set_mesh on the
+       mp=2 mesh (weights rule-sharded, KV cache heads on 'model') must
+       be token-identical to the dp-only decode of the same bundle —
+       sharding is layout, never arithmetic.
+    4. OOM-AT-DP-ONLY (real TPU only): size an LM past one chip's HBM
+       from memory_stats, confirm dp-only init OOMs where mp=2 fits —
+       the capability claim tensor parallelism is FOR.  Skips with a
+       reason on backends without memory_stats (CPU smoke).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from mmlspark_tpu.models.definitions import build_model
+    from mmlspark_tpu.parallel.mesh import MeshSpec, batch_sharding, make_mesh
+    from mmlspark_tpu.parallel.partition import (DEFAULT_RULES,
+                                                 UNMATCHED_REPLICATE,
+                                                 gather_tree,
+                                                 match_partition_rules,
+                                                 shard_tree)
+
+    out = {
+        "metric": "transformer_lm_tensor_parallel_mp2_tokens_per_sec_per_chip",
+        "value": None,
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # the reference has no model-parallel path
+    }
+
+    # -- arm 1: rule-matching + gather/re-shard pin (runs everywhere) ----
+    pin_cfg = {"vocab_size": 64, "d_model": 32, "n_heads": 4,
+               "n_layers": 2, "max_len": 32}
+    pin_model = build_model("TransformerLM", pin_cfg)
+    pin_params = pin_model.init(jax.random.key(0),
+                                np.zeros((1, 8), np.int32))["params"]
+    specs = match_partition_rules(pin_params, DEFAULT_RULES)
+    blk = specs["block0_w"]
+    out["rule_match_ok"] = bool(
+        blk["qkv"]["kernel"] == P(None, "model")
+        and blk["proj"]["kernel"] == P("model", None)
+        and blk["mlp_up"]["kernel"] == P(None, "model")
+        and blk["mlp_down"]["kernel"] == P("model", None)
+        and blk["qkv"]["bias"] == P()
+        and blk["LayerNorm_0"]["scale"] == P())
+    mesh11 = make_mesh(MeshSpec(data=1, model=1), jax.devices()[:1])
+    sharded = shard_tree(pin_params, mesh11, DEFAULT_RULES,
+                         on_unmatched=UNMATCHED_REPLICATE)
+    back = gather_tree(sharded, mesh11)
+    out["gather_reshard_ok"] = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(pin_params),
+                        jax.tree_util.tree_leaves(back)))
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        out["mp2_skip_reason"] = ("fewer than 2 devices: a ('data','model') "
+                                  "mesh needs at least model=2")
+        out["oom_arm_skip_reason"] = out["mp2_skip_reason"]
+        return out
+
+    # -- arm 2: train, dp-only vs dp x mp=2 over the same devices --------
+    from mmlspark_tpu.train import Trainer, TrainerConfig
+    n_use = n_dev if n_dev % 2 == 0 else n_dev - 1
+    if smoke:
+        cfg = {"vocab_size": 256, "d_model": 64, "n_heads": 4,
+               "n_layers": 2, "max_len": 128}
+        s, iters = 128, 3
+    else:
+        cfg = {"vocab_size": 8192, "d_model": 1024, "n_heads": 8,
+               "n_layers": 4, "max_len": 1024}
+        s, iters = 1024, 10
+    # one global batch divisible by BOTH data extents (n_use and n_use/2)
+    # so the two arms train the same workload and per-chip rates compare
+    global_b = 2 * n_use
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg["vocab_size"],
+                          (global_b, s)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1)
+
+    def per_chip_rate(dp, mp):
+        mesh = make_mesh(MeshSpec(data=dp, model=mp),
+                         jax.devices()[:dp * mp])
+        trainer = Trainer(TrainerConfig(
+            architecture="TransformerLM", model_config=dict(cfg),
+            optimizer="adam", learning_rate=1e-3, epochs=1,
+            batch_size=global_b, loss="softmax_xent",
+            tensor_parallel=True, seed=0), mesh=mesh)
+        state = trainer.init_state((global_b, s), input_dtype=np.int32)
+        step = trainer.make_train_step()
+        sh = batch_sharding(mesh)
+        xb = jax.device_put(jnp.asarray(tokens), sh)
+        yb = jax.device_put(jnp.asarray(targets), sh)
+        mask = jax.device_put(jnp.ones((global_b,), jnp.float32), sh)
+        state, loss, _ = step(state, xb, yb, mask)  # compile + warm
+        float(loss)  # real sync (see bench_lm_train)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss, _ = step(state, xb, yb, mask)
+        final = float(loss)
+        wall = time.perf_counter() - t0
+        return iters * global_b * s / wall / (dp * mp), final
+
+    dp_rate, dp_loss = per_chip_rate(n_use, 1)
+    mp_rate, mp_loss = per_chip_rate(n_use // 2, 2)
+    out["value"] = round(mp_rate, 1)
+    out["dp_tokens_per_sec_per_chip"] = round(dp_rate, 1)
+    out["mp2_tokens_per_sec_per_chip"] = round(mp_rate, 1)
+    out["mp2_vs_dp_per_chip_ratio"] = round(mp_rate / dp_rate, 3) \
+        if dp_rate else None
+    out["dp_final_loss"] = round(dp_loss, 4)
+    out["mp2_final_loss"] = round(mp_loss, 4)
+    out["devices"] = n_use
+    out["global_batch"] = global_b
+    out["seq_len"] = s
+
+    # -- arm 3: greedy decode parity + rate on the mp=2 mesh -------------
+    from mmlspark_tpu import DataTable
+    from mmlspark_tpu.models import TextGenerator
+    from mmlspark_tpu.models.bundle import ModelBundle
+    dec_cfg = {"vocab_size": 256, "d_model": 64, "n_heads": 4,
+               "n_layers": 2, "max_len": 64} if smoke else \
+        {"vocab_size": 8192, "d_model": 512, "n_heads": 8,
+         "n_layers": 4, "max_len": 256}
+    dec_new = 8 if smoke else 64
+    dec_b = 2 * (n_use // 2)
+    bundle = ModelBundle.init(build_model("TransformerLM", dec_cfg),
+                              (1, 8), seed=1)
+    prompts = rng.integers(0, dec_cfg["vocab_size"],
+                           (dec_b, 8)).astype(np.int32)
+    table = DataTable({"prompt": prompts})
+    plain = TextGenerator(bundle, inputCol="prompt", outputCol="gen",
+                          maxNewTokens=dec_new).transform(table)["gen"]
+    mp_mesh = make_mesh(MeshSpec(data=n_use // 2, model=2),
+                        jax.devices()[:n_use])
+    mp_gen = TextGenerator(bundle, inputCol="prompt", outputCol="gen",
+                           maxNewTokens=dec_new).set_mesh(mp_mesh)
+    mp_gen.transform(table)  # compile + warm
+    t0 = time.perf_counter()
+    mp_tokens = mp_gen.transform(table)["gen"]
+    dec_wall = time.perf_counter() - t0
+    out["decode_tokens_match"] = bool(
+        np.array_equal(np.asarray(mp_tokens), np.asarray(plain)))
+    out["mp2_decode_tokens_per_sec"] = round(dec_b * dec_new / dec_wall, 1)
+
+    # -- arm 4: OOM at dp-only, fits at mp=2 (real-TPU capability) -------
+    dev0 = jax.devices()[0]
+    stats = getattr(dev0, "memory_stats", lambda: None)()
+    if dev0.platform != "tpu" or not stats or "bytes_limit" not in stats:
+        out["oom_arm_skip_reason"] = (
+            f"backend {dev0.platform!r} exposes no HBM bytes_limit; the "
+            "OOM-at-dp-only arm needs a real TPU memory ceiling")
+        return out
+    try:
+        # size params so replicated state (params+grads+2 adam moments,
+        # ~16 bytes/param f32) overflows ONE chip but halves under mp=2
+        limit = int(stats["bytes_limit"])
+        n_layers = 4
+        target_params = int(1.5 * limit / 16)
+        d_model = int(np.sqrt(target_params / (12 * n_layers)) // 128 * 128)
+        big = {"vocab_size": 8192, "d_model": d_model, "n_heads": 8,
+               "n_layers": n_layers, "max_len": 256}
+
+        def try_init(dp, mp):
+            mesh = make_mesh(MeshSpec(data=dp, model=mp),
+                             jax.devices()[:dp * mp])
+            t = Trainer(TrainerConfig(
+                architecture="TransformerLM", model_config=dict(big),
+                optimizer="adam", learning_rate=1e-3, epochs=1,
+                batch_size=dp, loss="softmax_xent",
+                tensor_parallel=True, seed=0), mesh=mesh)
+            st = t.init_state((dp, 256), input_dtype=np.int32)
+            jax.block_until_ready(st.params)
+
+        oom = False
+        try:
+            try_init(n_use, 1)
+        except Exception as e:  # RESOURCE_EXHAUSTED surfaces as XlaRuntimeError
+            oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+            if not oom:
+                raise
+        out["oom_dp_only"] = oom
+        try_init(n_use // 2, 2)
+        out["oom_mp2_fits"] = True
+        out["oom_model_params"] = int(12 * n_layers * d_model * d_model
+                                      + 2 * big["vocab_size"] * d_model)
+    except Exception as e:
+        out["oom_arm_skip_reason"] = f"OOM arm failed: {type(e).__name__}: {e}"
+    return out
+
+
 def bench_serve(smoke: bool) -> dict:
     """Online-serving arm (serve/): robustness claims, measured.
 
@@ -1338,6 +1540,9 @@ def main():
     print(json.dumps(bench_lm_train(args.smoke, long_context=True)),
           flush=True)
     print(json.dumps(bench_lm_decode(args.smoke)), flush=True)
+    # tensor-parallel arms: registry rule/gather pins (every backend),
+    # mp=2 train/decode vs dp-only (2+ devices), OOM-at-dp-only (TPU)
+    print(json.dumps(bench_lm_tensor_parallel(args.smoke)), flush=True)
     # online-serving robustness claims: continuous-batching goodput vs
     # static batches, overload shedding, corruption gate
     print(json.dumps(bench_serve(args.smoke)), flush=True)

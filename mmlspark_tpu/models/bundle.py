@@ -58,6 +58,24 @@ class ModelBundle:
     def module(self) -> nn.Module:
         return build_model(self.architecture, self.config)
 
+    def partition_rules(self) -> Optional[tuple]:
+        """The partition-rule set this bundle was trained under (carried
+        as JSON in metadata["partition"]["rules"], the
+        parallel/partition.py round-trip), or None for a pre-partition
+        bundle — consumers then fall back to DEFAULT_RULES."""
+        data = (self.metadata or {}).get("partition", {}).get("rules")
+        if not data:
+            return None
+        from mmlspark_tpu.parallel.partition import rules_from_json
+        return rules_from_json(data)
+
+    def partition_mesh_shape(self) -> Optional[dict]:
+        """{"data": dp, "model": mp} the bundle was produced under, or
+        None; arrays are always full-shape, so this is advisory (error
+        messages, bench provenance) — any topology can load the bundle."""
+        shape = (self.metadata or {}).get("partition", {}).get("mesh")
+        return dict(shape) if shape else None
+
     @staticmethod
     def from_module(module: nn.Module, variables: dict,
                     metadata: Optional[dict] = None) -> "ModelBundle":
@@ -99,6 +117,22 @@ def _to_plain(tree):
     return tree
 
 
+def _full_host_array(x) -> np.ndarray:
+    """One leaf -> a full-logical-shape host array.  Model-sharded leaves
+    under single-process meshes are fully addressable (np.asarray
+    reassembles the shards); multi-host shards are gathered through a
+    replicated identity first.  Either way what lands on disk carries the
+    full shape — checkpoints stay topology-portable (restore re-commits
+    onto whatever dp x mp mesh is live)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.sharding import PartitionSpec as P
+
+        from mmlspark_tpu.parallel.partition import named_sharding
+        rep = named_sharding(x.sharding.mesh, P())
+        x = jax.jit(lambda t: t, out_shardings=rep)(x)
+    return np.asarray(jax.device_get(x))
+
+
 def save_bundle(bundle: ModelBundle, path: str) -> None:
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "bundle.json"), "w") as f:
@@ -107,7 +141,8 @@ def save_bundle(bundle: ModelBundle, path: str) -> None:
             "config": bundle.config,
             "metadata": bundle.metadata,
         }, f, indent=1)
-    host_vars = jax.tree_util.tree_map(np.asarray, _to_plain(bundle.variables))
+    host_vars = jax.tree_util.tree_map(_full_host_array,
+                                       _to_plain(bundle.variables))
     with open(os.path.join(path, "params.msgpack"), "wb") as f:
         f.write(serialization.to_bytes(host_vars))
 

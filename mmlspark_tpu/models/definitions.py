@@ -213,13 +213,22 @@ class TransformerBlock(nn.Module):
         from mmlspark_tpu.ops.attention import (attention, ring_attention,
                                                 ring_flash_attention,
                                                 ulysses_attention)
+        from mmlspark_tpu.parallel.partition import (HEADS_SPEC, HIDDEN_SPEC,
+                                                     shard_constraint)
         b, s, _ = x.shape
         d_head = self.d_model // self.n_heads
         h = nn.LayerNorm(dtype=self.dtype)(x)
         qkv = nn.Dense(3 * self.d_model, dtype=self.dtype, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (b, s, self.n_heads, d_head)
-        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        # tensor-parallel hint (no-op off-mesh): heads ride the 'model'
+        # axis, matching the column-parallel qkv kernel split — each chip
+        # attends over its own head slice.  Sequence-sharded variants run
+        # under shard_map, where GSPMD hints do not apply (manual axes).
+        seq_sharded = self.seq_axis is not None and self.attn_impl != "dense"
+        def heads(t):
+            return t if seq_sharded else shard_constraint(t, HEADS_SPEC)
+        q, k, v = (heads(t.reshape(shape)) for t in (q, k, v))
         if self.attn_impl == "dense":
             o = attention(q, k, v, causal=True)
         elif self.attn_impl == "flash":
@@ -245,7 +254,7 @@ class TransformerBlock(nn.Module):
         # P = exp(S - LSE) internally — re-running the forward kernel on
         # top of that is pure waste)
         from jax.ad_checkpoint import checkpoint_name
-        o = checkpoint_name(o, "attn_out")
+        o = checkpoint_name(heads(o), "attn_out")
         x = x + nn.Dense(self.d_model, dtype=self.dtype,
                          name="proj")(o.reshape(b, s, self.d_model))
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -264,6 +273,10 @@ class TransformerBlock(nn.Module):
                               name="moe")(h)
         h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype,
                      name="mlp_up")(h)
+        # the hidden slice rides 'model' with the column-parallel mlp_up
+        # kernel; mlp_down (row-parallel) contracts it back with one psum
+        if not seq_sharded:
+            h = shard_constraint(h, HIDDEN_SPEC)
         h = nn.gelu(h)
         return x + nn.Dense(self.d_model, dtype=self.dtype,
                             name="mlp_down")(h)

@@ -83,8 +83,26 @@ from mmlspark_tpu.observe.costmodel import capture_program_cost
 from mmlspark_tpu.observe.spans import active_timings, span_on
 from mmlspark_tpu.observe.telemetry import active_run
 from mmlspark_tpu.observe.trace import trace_event, trace_span
+from mmlspark_tpu.parallel.partition import (
+    KV_CACHE_SPEC,
+    KV_SCALE_SPEC,
+    shard_constraint,
+    use_mesh,
+)
 
 NEG_INF = -1e30
+
+
+def _hint_kv(c: jax.Array) -> jax.Array:
+    """KV-layout sharding hint: rank-4 (B, W, H, D) payloads carry heads
+    on 'model' (KV_CACHE_SPEC); rank-3 (B, W, H) int8-cache scales follow
+    (KV_SCALE_SPEC).  Off-mesh the hint is identity (shard_constraint
+    degrades), so every decode path stays single-device-portable."""
+    if c.ndim == 4:
+        return shard_constraint(c, KV_CACHE_SPEC)
+    if c.ndim == 3:
+        return shard_constraint(c, KV_SCALE_SPEC)
+    return c
 
 
 def _ln(p: dict, x: jax.Array, dtype) -> jax.Array:
@@ -267,10 +285,10 @@ def _prefill(params, prompts, module, prompt_len: int):
             f"decode program was built for prompt_len={prompt_len}")
     b = prompts.shape[0]
     dh = module.d_model // module.n_heads
-    caches = [(jnp.zeros((b, module.max_len, module.n_heads, dh),
-                         module.dtype),
-               jnp.zeros((b, module.max_len, module.n_heads, dh),
-                         module.dtype))
+    caches = [(_hint_kv(jnp.zeros((b, module.max_len, module.n_heads, dh),
+                                  module.dtype)),
+               _hint_kv(jnp.zeros((b, module.max_len, module.n_heads, dh),
+                                  module.dtype)))
               for _ in range(module.n_layers)]
     logits, caches = _forward_with_cache(params, prompts, caches, 0, module)
     return logits[:, -1], caches
@@ -690,7 +708,8 @@ def _merge_cache_rows_jit(dst_caches, src_caches, di, si):
     merged = []
     for dst_layer, src_layer in zip(dst_caches, src_caches):
         merged.append(tuple(
-            _grow_cache(d, window).at[di].set(_grow_cache(s, window)[si])
+            _hint_kv(_grow_cache(d, window).at[di].set(
+                _grow_cache(s, window)[si]))
             for d, s in zip(dst_layer, src_layer)))
     return merged
 
@@ -731,7 +750,7 @@ class DecodeEngine:
                  stop_tokens: tuple = (),
                  chunk: int = DEFAULT_CACHE_CHUNK,
                  min_bucket: int = DEFAULT_MIN_BUCKET,
-                 cache_dtype: str = "model"):
+                 cache_dtype: str = "model", mesh=None):
         _check_generatable(module)
         if cache_dtype not in ("model", "int8"):
             raise ValueError(
@@ -760,6 +779,10 @@ class DecodeEngine:
         self.chunk = chunk
         self.min_bucket = min_bucket
         self.cache_dtype = cache_dtype
+        # the mesh the KV hints target: every compiled program (prefill,
+        # segments, merge) traces under use_mesh(mesh), so at mp >= 2 the
+        # cache keeps heads on 'model' end to end; None = single-device
+        self.mesh = mesh
         greedy = temperature <= 0.0
         sample = _make_sampler(temperature,
                                None if greedy else top_k,
@@ -771,8 +794,10 @@ class DecodeEngine:
             b, p = prompts.shape
             w0 = _round_up(p + 1, chunk)
             dh = module.d_model // module.n_heads
-            caches = [(jnp.zeros((b, w0, module.n_heads, dh), module.dtype),
-                       jnp.zeros((b, w0, module.n_heads, dh), module.dtype))
+            caches = [(_hint_kv(jnp.zeros((b, w0, module.n_heads, dh),
+                                          module.dtype)),
+                       _hint_kv(jnp.zeros((b, w0, module.n_heads, dh),
+                                          module.dtype)))
                       for _ in range(module.n_layers)]
             logits, caches = _forward_with_cache(params, prompts, caches,
                                                  0, module)
@@ -784,13 +809,15 @@ class DecodeEngine:
                 # quantize-on-write at prefill granularity: the prompt's
                 # whole cache quantizes once here, decode steps quantize
                 # each new token inside _decode_block
-                caches = [_quantize_cache(kc, vc) for kc, vc in caches]
+                caches = [tuple(_hint_kv(c)
+                                for c in _quantize_cache(kc, vc))
+                          for kc, vc in caches]
             return tok, done, caches
 
         def segment_impl(seg_len, window, variables, caches, tok, done,
                          true_len, bucket, t0, row_keys):
             params = variables["params"]
-            caches = [tuple(_grow_cache(c, window) for c in layer)
+            caches = [tuple(_hint_kv(_grow_cache(c, window)) for c in layer)
                       for layer in caches]
             slots = jnp.arange(window)
 
@@ -829,7 +856,7 @@ class DecodeEngine:
             own cache row only and their emissions repeat the frozen
             token (the engine's per-row emit counters ignore them)."""
             params = variables["params"]
-            caches = [tuple(_grow_cache(c, window) for c in layer)
+            caches = [tuple(_hint_kv(_grow_cache(c, window)) for c in layer)
                       for layer in caches]
             slots_axis = jnp.arange(window)
             max_pos = module.max_len - 1
@@ -854,9 +881,26 @@ class DecodeEngine:
                 step, (tok, done, caches), jnp.arange(seg_len))
             return caches, toks.transpose(1, 0), tok, done
 
-        self._prefill = jax.jit(prefill_impl)
-        self._segment = jax.jit(segment_impl, static_argnums=(0, 1))
-        self._serve_segment = jax.jit(serve_segment_impl,
+        # jit the meshed wrappers, not the impls: tracing runs the body,
+        # so use_mesh(mesh) bakes the KV hints into every compiled
+        # program (and the attributes stay jit objects —
+        # capture_program_cost .lower()s them)
+        def prefill_meshed(variables, prompts, true_len, live, row_keys):
+            with use_mesh(mesh):
+                return prefill_impl(variables, prompts, true_len, live,
+                                    row_keys)
+
+        def segment_meshed(seg_len, window, *args):
+            with use_mesh(mesh):
+                return segment_impl(seg_len, window, *args)
+
+        def serve_segment_meshed(seg_len, window, *args):
+            with use_mesh(mesh):
+                return serve_segment_impl(seg_len, window, *args)
+
+        self._prefill = jax.jit(prefill_meshed)
+        self._segment = jax.jit(segment_meshed, static_argnums=(0, 1))
+        self._serve_segment = jax.jit(serve_segment_meshed,
                                       static_argnums=(0, 1))
         self._programs: set = set()
         self._program_costs: dict = {}  # program key -> captured cost row
@@ -923,7 +967,8 @@ class DecodeEngine:
         return _round_up(max(need, bucket + 1), self.chunk)
 
     @staticmethod
-    def merge_cache_rows(dst_caches, src_caches, dst_rows, src_rows):
+    def merge_cache_rows(dst_caches, src_caches, dst_rows, src_rows,
+                         mesh=None):
         """Splice cohort cache rows into a resident batch: row
         `src_rows[i]` of `src_caches` replaces row `dst_rows[i]` of
         `dst_caches`.  Both sides are grown to the wider window first
@@ -932,10 +977,13 @@ class DecodeEngine:
         cache layouts (2-tuple model-dtype, 4-tuple int8): every leaf is
         row-indexed on axis 0.  One jitted program per (windows, rows)
         shape class — a join is a handful of fused scatters, not a
-        cascade of eager ops."""
+        cascade of eager ops.  Pass `mesh` (an engine's `.mesh`) so the
+        merge program's KV hints trace against it — sharded resident
+        caches then stay sharded through every join."""
         di = jnp.asarray(dst_rows, jnp.int32)
         si = jnp.asarray(src_rows, jnp.int32)
-        return _merge_cache_rows_jit(dst_caches, src_caches, di, si)
+        with use_mesh(mesh):
+            return _merge_cache_rows_jit(dst_caches, src_caches, di, si)
 
     @property
     def compiled_programs(self) -> int:
@@ -1164,7 +1212,10 @@ class TextGenerator(Transformer):
         """Generate data-parallel over a device mesh: prompt batches are
         sharded along the 'data' axis (zero-padded to whole shards via
         pad_to_multiple — the TPUModel batching discipline) and weights
-        are replicated once per mesh.  Dense decode is purely batch-
+        are placed once per mesh — replicated at mp=1, partition-rule
+        sharded (heads/hidden on 'model', parallel/partition.py) when the
+        mesh carries a model axis, with the KV cache following on its
+        heads axis.  Dense decode is purely batch-
         parallel (no collectives in the scan; meshed output equals
         single-device output, test-pinned).  MoE decode routes each step
         cross-batch, so its dispatch spans the mesh AND the zero-pad
@@ -1204,17 +1255,28 @@ class TextGenerator(Transformer):
                 self._bundle.module(), self.maxNewTokens,
                 temperature=self.temperature, top_k=top_k, top_p=top_p,
                 stop_tokens=stops, chunk=self.cacheChunk,
-                cache_dtype=kv_dtype)
+                cache_dtype=kv_dtype, mesh=self._mesh)
         return self._compiled[key]
 
     def _device_variables(self):
-        """Weights replicated once per mesh (the TPUModel discipline)."""
+        """Weights placed once per mesh (the TPUModel discipline):
+        replicated on a dp-only mesh, partition-rule sharded when the
+        mesh has a model axis (the bundle's own rule set when it carries
+        one, DEFAULT_RULES otherwise)."""
         if self._mesh is None:
             return self._bundle.variables
         if self._mesh not in self._device_vars:
-            from mmlspark_tpu.parallel.bridge import replicate_tree
-            self._device_vars[self._mesh] = replicate_tree(
-                self._bundle.variables, self._mesh)
+            if self._mesh.shape.get("model", 1) > 1:
+                from mmlspark_tpu.parallel.partition import (
+                    UNMATCHED_REPLICATE, shard_tree)
+                self._device_vars[self._mesh] = shard_tree(
+                    self._bundle.variables, self._mesh,
+                    self._bundle.partition_rules(),
+                    on_unmatched=UNMATCHED_REPLICATE)
+            else:
+                from mmlspark_tpu.parallel.bridge import replicate_tree
+                self._device_vars[self._mesh] = replicate_tree(
+                    self._bundle.variables, self._mesh)
         return self._device_vars[self._mesh]
 
     def _transform_beam(self, rows: list, out: list) -> None:
@@ -1236,7 +1298,8 @@ class TextGenerator(Transformer):
                 prompts = put_sharded(prompts, batch_sharding(self._mesh))
             else:
                 prompts = jnp.asarray(prompts)
-            got = np.asarray(fn(variables, prompts))
+            with use_mesh(self._mesh):
+                got = np.asarray(fn(variables, prompts))
             for j, i in enumerate(idxs):
                 out[i] = got[j]
 

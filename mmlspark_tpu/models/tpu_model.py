@@ -35,7 +35,10 @@ from mmlspark_tpu.observe.trace import (active_tracer, current_span_id,
                                         span_on_tracer)
 from mmlspark_tpu.parallel.bridge import (pad_to_multiple, put_sharded,
                                           replicate_tree, reshard)
-from mmlspark_tpu.parallel.mesh import batch_sharding, best_mesh, replicated
+from mmlspark_tpu.parallel.mesh import (MODEL_AXIS, batch_sharding,
+                                        default_mesh, replicated)
+from mmlspark_tpu.parallel.partition import (UNMATCHED_REPLICATE, shard_tree,
+                                             use_mesh)
 from mmlspark_tpu.data import Dataset
 from mmlspark_tpu.parallel.prefetch import OncePerTable, resolve_depth
 
@@ -113,7 +116,9 @@ class TPUModel(Transformer):
 
     def _get_mesh(self):
         if self._mesh is None:
-            self._mesh = best_mesh()
+            # best_mesh() (dp-only) unless the MMLSPARK_TPU_MESH_* knobs
+            # ask for a dp x mp topology (parallel/mesh.default_mesh)
+            self._mesh = default_mesh()
         return self._mesh
 
     @staticmethod
@@ -191,8 +196,11 @@ class TPUModel(Transformer):
                 x = x.astype(jnp.float32)
             # int8 bundles: layers whose params carry the int8 layout run
             # their fused wrappers (quant/modules.py) — weights stay int8
-            # in HBM, dequant lives inside this compiled program
-            with quantized_call():
+            # in HBM, dequant lives inside this compiled program.
+            # use_mesh scopes the TRACE: shard_constraint hints in the
+            # forward (attention heads / MLP hidden on 'model') bake this
+            # mesh into the compiled program; no-ops on a 1-D mesh
+            with use_mesh(mesh), quantized_call():
                 out, state = module.apply(vars_, x, mutable=["intermediates"])
             inter = state.get("intermediates", {})
             inter = {k: v for k, v in inter.items() if not isinstance(v, dict)}
@@ -201,9 +209,15 @@ class TPUModel(Transformer):
                 out = out.astype(jnp.float32)
             return out
 
+        # weights enter under whatever layout _device_state placed them
+        # in (replicated on dp-only meshes, rule-sharded at mp >= 2), so
+        # the compiled program never silently re-gathers a sharded tree
+        var_shardings = jax.tree_util.tree_map(
+            lambda a: a.sharding if isinstance(a, jax.Array)
+            else replicated(mesh), variables)
         return jax.jit(
             forward,
-            in_shardings=(replicated(mesh), batch_sharding(mesh)),
+            in_shardings=(var_shardings, batch_sharding(mesh)),
             out_shardings=batch_sharding(mesh),
         )
 
@@ -219,8 +233,19 @@ class TPUModel(Transformer):
             raise ValueError("TPUModel has no model bundle; call set_bundle()")
         mesh = self._get_mesh()
         if mesh not in self._device_vars:
-            self._device_vars[mesh] = replicate_tree(
-                self._bundle.variables, mesh)
+            if mesh.shape.get(MODEL_AXIS, 1) > 1:
+                # tensor-parallel scoring: weights follow the bundle's
+                # own partition rules (metadata round-trip) — or
+                # DEFAULT_RULES for a pre-partition bundle — instead of
+                # replicating, so each chip holds 1/mp of the matched
+                # kernels (the dp-only HBM cap lifts)
+                self._device_vars[mesh] = shard_tree(
+                    self._bundle.variables, mesh,
+                    self._bundle.partition_rules(),
+                    on_unmatched=UNMATCHED_REPLICATE)
+            else:
+                self._device_vars[mesh] = replicate_tree(
+                    self._bundle.variables, mesh)
         variables = self._device_vars[mesh]
         key = (mesh, self.outputNodeName, self.outputNodeIndex,
                self.computeDtype)

@@ -171,12 +171,8 @@ class MoEMLP(nn.Module):
         xg = xf.reshape(g, gs, d).astype(jnp.float32)
         slots = jnp.einsum("gtec,gtd->egcd", dispatch, xg).astype(self.dtype)
         if self.expert_axis is not None:
-            try:
-                from jax.sharding import PartitionSpec as P
-                slots = jax.lax.with_sharding_constraint(
-                    slots, P(self.expert_axis))
-            except (ValueError, RuntimeError):
-                pass  # no mesh in scope (eager CPU tests): constraint is moot
+            from mmlspark_tpu.parallel.partition import expert_constraint
+            slots = expert_constraint(slots, self.expert_axis)
         hmid = nn.relu(jnp.einsum("egcd,edh->egch", slots,
                                   w_in.astype(self.dtype)))
         out = jnp.einsum("egch,ehd->egcd", hmid, w_out.astype(self.dtype))
@@ -209,15 +205,18 @@ def expert_parallel_rules(params: dict, mesh,
     expert tensors shard their leading (expert) dim over `axis`
     (`is_expert_stack` decides what qualifies); everything else
     replicates.  Feed to `jax.device_put` / `jit(in_shardings=...)` —
-    XLA then places the EP all_to_all traffic (GSPMD).
+    XLA then places the EP all_to_all traffic (GSPMD).  Construction goes
+    through parallel/partition.py (the sanctioned NamedSharding site).
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
+
+    from mmlspark_tpu.parallel.partition import named_sharding
 
     axis_size = mesh.shape.get(axis, 1)
 
     def rule(path, leaf):
         if is_expert_stack(path, leaf.shape, axis_size):
-            return NamedSharding(mesh, P(axis, None, None))
-        return NamedSharding(mesh, P())
+            return named_sharding(mesh, P(axis, None, None))
+        return named_sharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(rule, params)

@@ -2,8 +2,19 @@ from mmlspark_tpu.parallel.mesh import (
     MeshSpec,
     batch_sharding,
     best_mesh,
+    default_mesh,
     make_mesh,
+    mesh_spec_from_config,
     replicated,
+)
+from mmlspark_tpu.parallel.partition import (
+    DEFAULT_RULES,
+    match_partition_rules,
+    make_gather_fns,
+    make_shard_fns,
+    shard_constraint,
+    shard_tree,
+    use_mesh,
 )
 from mmlspark_tpu.parallel.bridge import (
     device_to_host,

@@ -158,23 +158,35 @@ def put_tree(tree: Any, shardings: Any) -> Any:
     return jax.tree_util.tree_map(jax.device_put, tree, shardings)
 
 
-def put_like(new: Any, old: Any) -> Any:
+def put_like(new: Any, old: Any, mesh: Optional[Mesh] = None) -> Any:
     """Place `new` with `old`'s sharding (checkpoint restore: host values
     re-committed onto the live state's layout); passthrough when `old`
-    carries no sharding (plain host leaves)."""
-    if hasattr(old, "sharding"):
-        return jax.device_put(new, old.sharding)
-    return new
+    carries no sharding (plain host leaves).  With `mesh`, leaves whose
+    live sharding is single-device (uncommitted scalars such as optax
+    step counters) are committed mesh-replicated instead: copying the
+    single-device placement would pin them to the default device, which
+    a jitted step rejects when the mesh is a strict subset of the
+    process's devices (elastic resume onto fewer chips)."""
+    if not hasattr(old, "sharding"):
+        return new
+    sharding = old.sharding
+    if mesh is not None and isinstance(sharding,
+                                       jax.sharding.SingleDeviceSharding):
+        sharding = replicated(mesh)
+    return jax.device_put(new, sharding)
 
 
-def put_tree_like(new_tree: Any, like_tree: Any) -> Any:
+def put_tree_like(new_tree: Any, like_tree: Any,
+                  mesh: Optional[Mesh] = None) -> Any:
     """Reshard-on-restore: commit a host pytree onto the shardings of a
     live tree built for the CURRENT mesh.  Checkpoints store gathered
     (full logical shape) arrays, so their global shapes are
     device-count-independent — a state saved under dp=N lands correctly
     on an M-device mesh because the target layout comes from the live
-    state, never from the file (elastic resume, train/trainer.py)."""
-    return jax.tree_util.tree_map(put_like, new_tree, like_tree)
+    state, never from the file (elastic resume, train/trainer.py).
+    `mesh` promotes single-device leaves to mesh-replicated (put_like)."""
+    return jax.tree_util.tree_map(lambda n, o: put_like(n, o, mesh),
+                                  new_tree, like_tree)
 
 
 def replicate_tree(tree: Any, mesh: Mesh) -> Any:

@@ -23,9 +23,24 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from mmlspark_tpu import config
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+
+MESH_DATA = config.register(
+    "MMLSPARK_TPU_MESH_DATA", default=-1, ptype=int,
+    doc="Data-parallel mesh width for the default dp x mp mesh "
+        "(mesh_spec_from_config); -1 = all devices left over after the "
+        "model axis.")
+
+MESH_MODEL = config.register(
+    "MMLSPARK_TPU_MESH_MODEL", default=1, ptype=int,
+    doc="Tensor/model-parallel mesh width for the default dp x mp mesh: "
+        "weights matched by the partition rules (parallel/partition.py) "
+        "shard over this many chips. 1 (default) keeps every path "
+        "data-parallel-only.")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +82,39 @@ def make_mesh(spec: Optional[MeshSpec] = None,
     axis_names = tuple(sizes)
     dev_array = np.asarray(devices).reshape(tuple(sizes.values()))
     return Mesh(dev_array, axis_names)
+
+
+def mesh_spec_from_config() -> MeshSpec:
+    """The MeshSpec the MMLSPARK_TPU_MESH_* knobs declare (dp x mp)."""
+    return MeshSpec(data=int(MESH_DATA.current()),
+                    model=int(MESH_MODEL.current()))
+
+
+def default_mesh() -> Mesh:
+    """The mesh scoring/training paths get when none is passed explicitly.
+
+    With the MESH knobs at their defaults this is exactly `best_mesh()`
+    (dp-only over local devices — the unchanged fast path).  Setting
+    `MMLSPARK_TPU_MESH_MODEL=2` (etc.) turns every default-mesh consumer
+    — TPUModel scoring, Trainer.fit_arrays, TextGenerator — into a dp x
+    mp run without touching call sites: weights follow the partition
+    rules (parallel/partition.py), batches stay on the data axis.
+    """
+    spec = mesh_spec_from_config()
+    if spec.model <= 1 and spec.data <= 0:
+        return best_mesh()
+    local = jax.local_devices() if jax.process_count() > 1 else jax.devices()
+    if spec.data <= 0:
+        sizes = spec.resolve(len(local))
+    else:
+        sizes = {"data": spec.data, "model": max(spec.model, 1),
+                 "seq": max(spec.seq, 1)}
+    n = sizes["data"] * sizes["model"] * sizes["seq"]
+    if n > len(local):
+        raise ValueError(
+            f"MMLSPARK_TPU_MESH_DATA x MODEL wants {n} devices, "
+            f"have {len(local)}")
+    return make_mesh(MeshSpec(**sizes), local[:n])
 
 
 def best_mesh(n_data: Optional[int] = None) -> Mesh:

@@ -27,6 +27,13 @@ save_bundle/load_bundle):
 
     {"kernel": int8 (..., out), "kernel_scale": float32 (out,), ...}
 
+Tensor parallelism composes with this layout through the partition-rule
+registry (parallel/partition.py): a ``*_scale`` leaf follows its kernel's
+OUTPUT-channel spec — a column-parallel kernel (P(None, 'model')) shards
+its (out,) scales over 'model' alongside it, a row-parallel kernel
+(P('model', None)) replicates them — so an int8 bundle scores at mp >= 2
+with no quant-specific placement code.
+
 A leaf is quantized iff it is named ``kernel``, is floating, and has rank
 2 (Dense) or 4 (2-D Conv); everything else floating becomes bfloat16.
 The whole ``moe`` subtree (expert stacks AND router, ops/moe.py)

@@ -188,20 +188,26 @@ class ServingEngine:
     """
 
     def __init__(self, bundle, cfg: Optional[ServeConfig] = None, *,
-                 degraded_bundle=None, clock: Optional[Clock] = None):
+                 degraded_bundle=None, clock: Optional[Clock] = None,
+                 mesh=None):
         self.cfg = cfg or ServeConfig()
         self._clock = clock
         self._bundle = bundle
         self._module = bundle.module()
+        # serving over a device mesh: weights are placed once (replicated
+        # at mp=1, partition-rule sharded when the mesh has a model axis)
+        # and every DecodeEngine program traces its KV hints against it
+        self._mesh = mesh
         self._engines = {"primary": self._decode_engine(self._module)}
-        self._variables = {"primary": bundle.variables}
+        self._variables = {"primary": self._place_variables(bundle)}
         if degraded_bundle is not None:
             deg = degraded_bundle.module()
             if deg.vocab_size != self._module.vocab_size:
                 raise ValueError(
                     "degraded bundle must share the primary vocabulary")
             self._engines["degraded"] = self._decode_engine(deg)
-            self._variables["degraded"] = degraded_bundle.variables
+            self._variables["degraded"] = self._place_variables(
+                degraded_bundle)
         self.estimator = StepTimeEstimator()
         self.breaker = MissRateBreaker(
             "serve", window=self.cfg.miss_window,
@@ -240,7 +246,22 @@ class ServingEngine:
             module, self.cfg.max_new_tokens,
             temperature=self.cfg.temperature, top_k=self.cfg.top_k,
             top_p=self.cfg.top_p, stop_tokens=self.cfg.stop_tokens,
-            chunk=self.cfg.cache_chunk)
+            chunk=self.cfg.cache_chunk, mesh=self._mesh)
+
+    def _place_variables(self, bundle):
+        """One-time weight placement for a lane: host tree off-mesh,
+        replicated on a dp-only mesh, partition-rule sharded (the
+        bundle's own rules, else DEFAULT_RULES) at mp >= 2."""
+        if self._mesh is None:
+            return bundle.variables
+        if self._mesh.shape.get("model", 1) > 1:
+            from mmlspark_tpu.parallel.partition import (
+                UNMATCHED_REPLICATE, shard_tree)
+            return shard_tree(bundle.variables, self._mesh,
+                              bundle.partition_rules(),
+                              on_unmatched=UNMATCHED_REPLICATE)
+        from mmlspark_tpu.parallel.bridge import replicate_tree
+        return replicate_tree(bundle.variables, self._mesh)
 
     # -- lifecycle ---------------------------------------------------------
     def now(self) -> float:
@@ -327,7 +348,7 @@ class ServingEngine:
                     m *= 2
                 DecodeEngine.merge_cache_rows(
                     resident, cohorts[min(m, cap)],
-                    list(range(k)), list(range(k)))
+                    list(range(k)), list(range(k)), mesh=eng.mesh)
 
         budget = np.full(cap, self.cfg.max_new_tokens, np.int32)
         t_row = np.zeros(cap, np.int32)
@@ -652,7 +673,7 @@ class ServingEngine:
         if g.caches is None:
             g.caches = self._empty_caches(eng, g.capacity, g.bucket, lane)
         g.caches = DecodeEngine.merge_cache_rows(
-            g.caches, caches, slots, list(range(k)))
+            g.caches, caches, slots, list(range(k)), mesh=eng.mesh)
         for j, (req, slot) in enumerate(zip(reqs, slots)):
             g.rows[slot] = req
             g.tok[slot] = tok_h[j]

@@ -49,6 +49,12 @@ class TrainerConfig:
     # shard MoE expert stacks' leading (E, ...) dim over 'model' (expert
     # parallelism; GSPMD places the all_to_all dispatch traffic)
     expert_parallel: bool = True
+    # partition-rule registry override (parallel/partition.py): ordered
+    # (regex-over-param-path, PartitionSpec) pairs, first match wins.
+    # None = DEFAULT_RULES (the Megatron split for TransformerLM trees,
+    # replication elsewhere — the generic wide-kernel heuristic still
+    # applies to leaves the rules replicate).
+    partition_rules: Optional[tuple] = None
     # GPipe pipeline parallelism over 'model' (TransformerLM only): the
     # block stack splits into this many stages, microbatches flow through
     # the ring (parallel/pipeline.py); 1 = off
@@ -111,10 +117,22 @@ class TrainerConfig:
                 f"optimizer must be one of {OPTIMIZERS}, got {self.optimizer!r}")
         if isinstance(self.mesh, dict):
             self.mesh = MeshSpec(**self.mesh)
+        if self.partition_rules is not None:
+            # accept real (pattern, PartitionSpec) rules or the
+            # rules_to_json wire form (lists of [pattern, entries])
+            from jax.sharding import PartitionSpec
+            from mmlspark_tpu.parallel.partition import rules_from_json
+            rules = tuple(tuple(r) for r in self.partition_rules)
+            if rules and not isinstance(rules[0][1], PartitionSpec):
+                rules = rules_from_json(rules)
+            self.partition_rules = rules
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
         d["mesh"] = dataclasses.asdict(self.mesh)
+        if self.partition_rules is not None:
+            from mmlspark_tpu.parallel.partition import rules_to_json
+            d["partition_rules"] = rules_to_json(self.partition_rules)
         return d
 
     @staticmethod
@@ -122,6 +140,9 @@ class TrainerConfig:
         d = dict(d)
         if "mesh" in d:
             d["mesh"] = MeshSpec(**d["mesh"])
+        if d.get("partition_rules") is not None:
+            from mmlspark_tpu.parallel.partition import rules_from_json
+            d["partition_rules"] = rules_from_json(d["partition_rules"])
         return TrainerConfig(**d)
 
     def save(self, path: str) -> None:

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import struct
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from mmlspark_tpu.models.bundle import ModelBundle, _to_plain
 from mmlspark_tpu.models.definitions import build_model
@@ -46,11 +46,15 @@ from mmlspark_tpu.observe.trace import (active_tracer, current_span_id,
                                         span_on_tracer, trace_event,
                                         trace_span)
 from mmlspark_tpu.parallel.bridge import (gather_replicated, gather_to_host,
-                                          put_sharded, put_tree,
+                                          put_like, put_sharded, put_tree,
                                           put_tree_like, snapshot_tree)
 from mmlspark_tpu.parallel.distributed import (barrier, initialize_distributed,
                                                is_coordinator, run_collective)
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh, replicated
+from mmlspark_tpu.parallel.partition import (UNMATCHED_REPLICATE,
+                                             compatible_spec, leaf_spec,
+                                             named_sharding, path_str,
+                                             rules_to_json, use_mesh)
 from mmlspark_tpu.data import Dataset
 from mmlspark_tpu.resilience.chaos import get_injector
 from mmlspark_tpu.resilience.checkpoints import (checkpoint_meta,
@@ -72,26 +76,44 @@ class TrainState:
 
 
 def _param_sharding_rule(mesh, tensor_parallel: bool,
-                         expert_parallel: bool = True):
-    """Map each param leaf to a sharding: EP for MoE expert stacks (their
-    leading (E, ...) dim over 'model' — ops/moe.py expert_parallel_rules
-    folded into the product surface, so a MoE model trained through
-    Trainer gets sharded experts, not replicas), TP over 'model' for wide
-    dense kernels, replication otherwise."""
+                         expert_parallel: bool = True,
+                         partition_rules=None):
+    """Map each param leaf to a sharding.  The partition-rule registry
+    (parallel/partition.py) is consulted first: a leaf whose matched spec
+    survives `compatible_spec` demotion gets the registry layout — the
+    Megatron split for TransformerLM trees (column-parallel qkv/mlp_up/
+    lm_head, row-parallel proj/mlp_down), expert stacks over 'model'.
+    Leaves the registry replicates fall back to the legacy heuristics —
+    EP for MoE expert stacks (ops/moe.py expert_parallel_rules folded
+    into the product surface) and generic last-dim TP for wide dense
+    kernels — so non-transformer architectures (ConvNet) keep their
+    sharded training path unchanged."""
     model_size = mesh.shape.get(MODEL_AXIS, 1)
 
     from mmlspark_tpu.ops.moe import is_expert_stack
+    from mmlspark_tpu.parallel.partition import DEFAULT_RULES
+    rules = tuple(DEFAULT_RULES if partition_rules is None
+                  else partition_rules)
 
-    def rule(path, leaf: jax.ShapeDtypeStruct) -> NamedSharding:
+    def rule(path, leaf: jax.ShapeDtypeStruct):
         shape = leaf.shape
+        if tensor_parallel and model_size > 1:
+            spec = compatible_spec(
+                leaf_spec(path_str(path), shape, rules,
+                          UNMATCHED_REPLICATE), shape, mesh)
+            # expert_parallel=False must win over the registry's moe rule
+            if len(spec) and (expert_parallel
+                              or not is_expert_stack(path, shape,
+                                                     model_size)):
+                return named_sharding(mesh, spec)
         if (expert_parallel and model_size > 1
                 and is_expert_stack(path, shape, model_size)):
-            return NamedSharding(mesh, P(MODEL_AXIS, None, None))
+            return named_sharding(mesh, P(MODEL_AXIS, None, None))
         if (tensor_parallel and model_size > 1 and len(shape) >= 2
                 and shape[-1] % model_size == 0 and shape[-1] >= model_size * 8):
             spec = [None] * len(shape)
             spec[-1] = MODEL_AXIS
-            return NamedSharding(mesh, P(*spec))
+            return named_sharding(mesh, P(*spec))
         return replicated(mesh)
 
     return rule
@@ -254,7 +276,9 @@ class Trainer:
         batch_stats = variables.get("batch_stats", {})
 
         rule = _param_sharding_rule(self.mesh, self.config.tensor_parallel,
-                                    self.config.expert_parallel)
+                                    self.config.expert_parallel,
+                                    getattr(self.config, "partition_rules",
+                                            None))
         shardings = jax.tree_util.tree_map_with_path(
             lambda path, leaf: rule(
                 path, jax.ShapeDtypeStruct(np.shape(leaf),
@@ -335,6 +359,7 @@ class Trainer:
         module, loss_fn = self.module, self._loss
         has_train = self._has_train_arg
         tx = self._tx
+        mesh = self.mesh
 
         aux_w = float(self.config.aux_loss_weight)
         # numerics health (observe/numerics.py): when the probe cadence is
@@ -390,11 +415,20 @@ class Trainer:
                              for k in jax.eval_shape(probed)})
             return new_state, loss, metrics
 
+        # `use_mesh` scopes the TRACE (the body runs inside jit tracing):
+        # shard_constraint hints in the module forward (transformer heads
+        # / MLP hidden, parallel/partition.py) bake this trainer's mesh
+        # into the compiled step; on a 1-D mesh they are no-ops
         if not with_health:
             def plain_step(state, x, y, mask):
-                return train_step(state, x, y, mask)
+                with use_mesh(mesh):
+                    return train_step(state, x, y, mask)
             return jax.jit(plain_step, donate_argnums=(0,))
-        return jax.jit(train_step, donate_argnums=(0,))
+
+        def meshed_step(state, x, y, mask, probe=False):
+            with use_mesh(mesh):
+                return train_step(state, x, y, mask, probe)
+        return jax.jit(meshed_step, donate_argnums=(0,))
 
     # -- the loop --------------------------------------------------------
     def fit_arrays(self, x: np.ndarray, y: np.ndarray,
@@ -497,25 +531,43 @@ class Trainer:
                 set_restore_offsets(saved["data_snapshots"])
             saved_bs = int(saved.get("effective_batch_size") or 0)
             saved_dp = int(saved.get("data_devices") or 0)
-            if saved_dp and saved_dp != data_size:
+            saved_mp = int(saved.get("model_devices") or 0)
+            model_size = self.mesh.shape.get(MODEL_AXIS, 1)
+            if saved_mp and self._pp and saved_mp != model_size:
+                # the pipeline stage ring is NOT elastic: stage-sharded
+                # block stacks cannot re-partition across a different
+                # stage count mid-run
+                raise ValueError(
+                    f"checkpoint written under dp={saved_dp or '?'} x "
+                    f"mp={saved_mp} cannot resume onto the current "
+                    f"dp={data_size} x mp={model_size} mesh: pipeline "
+                    f"training requires the same stage count "
+                    f"(pipeline_stages == '{MODEL_AXIS}' axis size)")
+            if saved_dp and (saved_dp != data_size
+                             or (saved_mp and saved_mp != model_size)):
                 trace_event("train.elastic_resume", cat="resilience",
                             saved_dp=saved_dp, dp=data_size,
+                            saved_mp=saved_mp or 1, mp=model_size,
                             saved_batch=saved_bs or bs, batch=bs)
                 inc_counter("train.elastic_resumes")
                 get_logger("train").info(
-                    "elastic resume: checkpoint written under dp=%d, "
-                    "restoring onto dp=%d (reshard-on-restore)",
-                    saved_dp, data_size)
+                    "elastic resume: checkpoint written under dp=%d x "
+                    "mp=%d, restoring onto dp=%d x mp=%d "
+                    "(reshard-on-restore)", saved_dp, saved_mp or 1,
+                    data_size, model_size)
             if saved_bs and saved_bs != bs:
                 unit = data_size * (cfg.pipeline_microbatches
                                     if self._pp else 1)
                 if saved_bs % unit:
                     raise ValueError(
-                        f"elastic resume: checkpoint's effective batch "
-                        f"size {saved_bs} does not divide into the new "
-                        f"mesh's unit {unit} (data axis {data_size}); "
-                        f"pick a batch_size divisible by both device "
-                        f"counts to keep resumed runs reproducible")
+                        f"elastic resume: checkpoint written under "
+                        f"dp={saved_dp or '?'} x mp={saved_mp or 1} with "
+                        f"effective batch size {saved_bs} cannot replay "
+                        f"onto the current dp={data_size} x "
+                        f"mp={model_size} mesh ({saved_bs} does not "
+                        f"divide into the new data-axis unit {unit}); "
+                        f"pick a batch_size divisible by both topologies "
+                        f"to keep resumed runs reproducible")
                 get_logger("train").info(
                     "elastic resume: adopting the checkpoint's effective "
                     "batch size %d (config clamped to %d) so data order "
@@ -938,8 +990,24 @@ class Trainer:
         if state.batch_stats:
             variables["batch_stats"] = gather_to_host(state.batch_stats,
                                                       self.mesh)
+        # the bundle carries the layout it was trained under: the rule
+        # set (JSON form, parallel/partition.py round-trip) and the mesh
+        # shape, so scoring/decode re-shard the SAME way and a restore
+        # onto a different dp x mp topology can name both in errors.
+        # Arrays themselves are gathered full-shape — topology-portable.
+        from mmlspark_tpu.parallel.partition import DEFAULT_RULES
+        rules = getattr(self.config, "partition_rules", None) \
+            or DEFAULT_RULES
+        metadata = {
+            "steps": int(state.step),
+            "partition": {
+                "rules": rules_to_json(rules),
+                "mesh": {"data": int(self.mesh.shape.get(DATA_AXIS, 1)),
+                         "model": int(self.mesh.shape.get(MODEL_AXIS, 1))},
+            },
+        }
         return ModelBundle.from_module(self.module, variables,
-                                       metadata={"steps": int(state.step)})
+                                       metadata=metadata)
 
     # -- checkpoint / resume (absent in the reference; first-class here) --
     def _writer_for(self, ckpt_dir: str) -> CheckpointWriter:
@@ -1074,10 +1142,18 @@ class Trainer:
                 raise FileNotFoundError(
                     f"no valid checkpoint in {ckpt_dir}")
             restored = read_checkpoint(template, path)
+        # mesh= commits scalar leaves (step, optax counters) replicated on
+        # the trainer's mesh rather than copying their single-device init
+        # placement: when the mesh is a strict subset of the process's
+        # devices (elastic resume onto fewer chips), a default-device
+        # scalar would mix device sets inside the jitted train step
         return TrainState(
-            step=jnp.asarray(restored["step"]),
-            params=put_tree_like(restored["params"], state.params),
-            opt_state=put_tree_like(restored["opt_state"], state.opt_state),
+            step=put_like(jnp.asarray(restored["step"], jnp.int32),
+                          state.step, mesh=self.mesh),
+            params=put_tree_like(restored["params"], state.params,
+                                 mesh=self.mesh),
+            opt_state=put_tree_like(restored["opt_state"], state.opt_state,
+                                    mesh=self.mesh),
             batch_stats=put_tree_like(restored["batch_stats"],
-                                      state.batch_stats),
+                                      state.batch_stats, mesh=self.mesh),
         )

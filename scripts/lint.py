@@ -39,6 +39,13 @@ Checks, per file:
     numpy infers float64 from python floats, and an f64 array fed to the
     device either doubles the transfer bytes or hits jax's silent x64
     downcast — hot paths must pin dtypes explicitly
+  * raw `with_sharding_constraint` calls and `NamedSharding(...)`
+    construction inside `mmlspark_tpu/` outside `mmlspark_tpu/parallel/`
+    — placement decisions live behind the partition registry
+    (`parallel/partition.py`: shard_constraint/named_sharding/
+    tree_shardings), so model/train/serve code states WHERE a value
+    lives in spec terms and the mesh in scope decides what that means;
+    a raw constraint hard-binds one mesh and breaks off-mesh portability
   * thread-pool / queue / Prefetcher construction inside
     `mmlspark_tpu/data/` or `mmlspark_tpu/io/` outside the Dataset
     executor module (`data/executor.py`) — ingestion concurrency is
@@ -128,6 +135,12 @@ TRANSPORT_WHITELIST = {
 _SOCKET_CTOR_NAMES = ("create_connection", "create_server", "socketpair")
 _SUBPROCESS_CALL_NAMES = ("Popen", "run", "call", "check_call",
                           "check_output", "getoutput", "getstatusoutput")
+
+# the parallel package: with_sharding_constraint / NamedSharding
+# construction anywhere else in mmlspark_tpu/ bypasses the partition
+# registry (parallel/partition.py shard_constraint/named_sharding) —
+# the one seam that keeps placement portable across mesh topologies
+PARALLEL_DIR = os.path.join("mmlspark_tpu", "parallel")
 
 # the framework package: raw print()/root-logger output is forbidden here
 # (route through observe.logging); the report CLI is the one whitelisted
@@ -289,6 +302,31 @@ def _in_package(path: str) -> bool:
             and norm not in PRINT_WHITELIST)
 
 
+def _in_sharding_policy(path: str) -> bool:
+    norm = os.path.normpath(path)
+    return (norm.startswith(PACKAGE_DIR + os.sep)
+            and not norm.startswith(PARALLEL_DIR + os.sep))
+
+
+def _is_sharding_constraint_call(node: ast.Call) -> bool:
+    """Matches `jax.lax.with_sharding_constraint(...)` and the bare
+    from-import form (any attribute chain ending in the name)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "with_sharding_constraint"
+    return (isinstance(fn, ast.Attribute)
+            and fn.attr == "with_sharding_constraint")
+
+
+def _is_named_sharding_ctor(node: ast.Call) -> bool:
+    """Matches `NamedSharding(...)` / `jax.sharding.NamedSharding(...)`
+    construction — parallel/ (partition.named_sharding, mesh.py) owns it."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "NamedSharding"
+    return isinstance(fn, ast.Attribute) and fn.attr == "NamedSharding"
+
+
 def _is_print_call(node: ast.Call) -> bool:
     return isinstance(node.func, ast.Name) and node.func.id == "print"
 
@@ -369,7 +407,22 @@ def check_file(path: str) -> list[str]:
     in_serve_policy = _in_serve_policy(path)
     in_data_policy = _in_data_policy(path)
     in_transport_policy = _in_transport_policy(path)
+    in_sharding_policy = _in_sharding_policy(path)
     for node in ast.walk(tree):
+        if in_sharding_policy and isinstance(node, ast.Call):
+            if _is_sharding_constraint_call(node):
+                problems.append(
+                    f"{path}:{node.lineno}: raw with_sharding_constraint "
+                    f"inside mmlspark_tpu/ outside parallel/ — state "
+                    f"placement via parallel.partition.shard_constraint "
+                    f"(spec form, degrades to identity off-mesh)")
+            if _is_named_sharding_ctor(node):
+                problems.append(
+                    f"{path}:{node.lineno}: raw NamedSharding construction "
+                    f"inside mmlspark_tpu/ outside parallel/ — build "
+                    f"shardings via parallel.partition.named_sharding/"
+                    f"tree_shardings (or mesh.py helpers) so placement "
+                    f"stays behind the partition registry")
         if in_transport_policy and isinstance(node, ast.Call):
             if _is_raw_socket_ctor(node):
                 problems.append(

@@ -127,6 +127,41 @@ def _probe_shard_map_pallas():
     return None
 
 
+def _probe_mp2():
+    """A ('data', 'model') mesh with model=2 running one jitted forward
+    whose shard_constraint hint targets the model axis — the smallest
+    program that exercises what the tensor-parallel tests need (2+
+    devices plus GSPMD honoring a 2-D mesh constraint under jit)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+    from mmlspark_tpu.parallel.partition import shard_constraint, use_mesh
+    from jax.sharding import PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return ("fewer than 2 devices: a model-parallel ('data','model') "
+                "mesh needs at least model=2")
+    mesh = make_mesh(MeshSpec(data=1, model=2), devs[:2])
+
+    def fwd(x, w):
+        w = shard_constraint(w, P(None, "model"))
+        return x @ w
+
+    def meshed(x, w):
+        with use_mesh(mesh):
+            return fwd(x, w)
+
+    x = jnp.ones((2, 4), jnp.float32)
+    w = jnp.ones((4, 8), jnp.float32)
+    got = np.asarray(jax.jit(meshed)(x, w))
+    if not np.allclose(got, 4.0):
+        return "model-sharded matmul returned wrong values"
+    return None
+
+
 _MP_WORKER = """
 import sys
 import jax
@@ -228,6 +263,7 @@ _PROBES = {
     "lax_pcast": _probe_lax_pcast,
     "shard_map_checkpoint_name": _probe_shard_map_checkpoint_name,
     "shard_map_pallas": _probe_shard_map_pallas,
+    "mp2": _probe_mp2,
     "multiprocess_collectives": _probe_multiprocess_collectives,
     "package_installed": _probe_package_installed,
     "data_service_workers": _probe_data_service_workers,

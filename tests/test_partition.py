@@ -1,0 +1,396 @@
+"""Tensor-parallel sharding engine: the partition-rule registry, the 2-D
+(data, model) mesh, and the sharded train/score/decode paths.
+
+Three layers of contract, all on the 8-virtual-CPU-device mesh:
+
+  * the REGISTRY (parallel/partition.py): regex -> PartitionSpec matching
+    with first-match-wins precedence, the scalar/bias/kernel_scale
+    invariants, the explicit unmatched policy, and the JSON round-trip
+    the ModelBundle metadata rides;
+  * PLACEMENT: shard_tree/gather_tree round-trips on a real dp x mp
+    mesh, spec demotion for shapes the mesh cannot tile, and
+    save_bundle's gather-to-full-shape (checkpoints stay
+    topology-portable);
+  * the PRODUCT paths: Trainer checkpoints written under dp-only restore
+    byte-identically onto a dp x mp mesh (and back), TPUModel scoring and
+    greedy decode at mp=2 match the single-device answers, and the
+    pipeline-parallel stage-count guard names both topologies.
+"""
+
+import json
+import shutil
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mmlspark_tpu import DataTable
+from mmlspark_tpu.models import TPUModel
+from mmlspark_tpu.models.bundle import ModelBundle, load_bundle, save_bundle
+from mmlspark_tpu.models.definitions import build_model
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+from mmlspark_tpu.parallel.partition import (
+    DEFAULT_RULES,
+    UNMATCHED_REPLICATE,
+    compatible_spec,
+    gather_tree,
+    leaf_spec,
+    match_partition_rules,
+    rules_from_json,
+    rules_to_json,
+    shard_tree,
+    tree_shardings,
+)
+from mmlspark_tpu.train import Trainer, TrainerConfig
+
+RNG = np.random.default_rng(11)
+TOKS = RNG.integers(0, 32, (16, 12)).astype(np.int32)
+TGTS = np.roll(TOKS, -1, axis=1).astype(np.int32)
+
+LM_MODEL = {"vocab_size": 32, "d_model": 16, "n_heads": 4, "n_layers": 2,
+            "max_len": 24, "dtype": "float32"}
+
+
+def _arr(*shape):
+    return np.zeros(shape, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# The rule registry
+# ---------------------------------------------------------------------------
+
+def test_default_rules_megatron_split():
+    tree = {
+        "block0_w": {
+            "qkv": {"kernel": _arr(16, 48), "bias": _arr(48)},
+            "proj": {"kernel": _arr(16, 16), "bias": _arr(16)},
+            "mlp_up": {"kernel": _arr(16, 64), "bias": _arr(64)},
+            "mlp_down": {"kernel": _arr(64, 16), "bias": _arr(16)},
+            "moe": {"w_in": _arr(4, 16, 64), "w_out": _arr(4, 64, 16),
+                    "router": {"kernel": _arr(16, 4)}},
+        },
+        "tok_embed": {"embedding": _arr(32, 16)},
+        "lm_head": {"kernel": _arr(16, 32), "bias": _arr(32)},
+    }
+    specs = match_partition_rules(tree)
+    blk = specs["block0_w"]
+    assert blk["qkv"]["kernel"] == P(None, "model")       # column-parallel
+    assert blk["mlp_up"]["kernel"] == P(None, "model")
+    assert specs["lm_head"]["kernel"] == P(None, "model")
+    assert blk["proj"]["kernel"] == P("model", None)      # row-parallel
+    assert blk["mlp_down"]["kernel"] == P("model", None)
+    assert blk["moe"]["w_in"] == P("model", None, None)   # expert axis
+    assert blk["moe"]["w_out"] == P("model", None, None)
+    # replicated: embeddings, the router, and every bias
+    assert specs["tok_embed"]["embedding"] == P()
+    assert blk["moe"]["router"]["kernel"] == P()
+    assert blk["qkv"]["bias"] == P()
+
+
+def test_first_match_wins_precedence():
+    rules = (
+        (r"special/kernel$", P("model", None)),
+        (r"kernel$", P(None, "model")),
+        (r".*", P()),
+    )
+    tree = {"special": {"kernel": _arr(8, 8)},
+            "plain": {"kernel": _arr(8, 8)}}
+    specs = match_partition_rules(tree, rules)
+    assert specs["special"]["kernel"] == P("model", None)
+    assert specs["plain"]["kernel"] == P(None, "model")
+    # reversed order: the generic rule now shadows the specific one
+    specs = match_partition_rules(tree, rules[1:] + rules[:1])
+    assert specs["special"]["kernel"] == P(None, "model")
+
+
+def test_scalar_and_size_one_leaves_never_sharded():
+    rules = ((r".*", P("model")),)
+    assert leaf_spec("loss_scale", (), rules) == P()
+    assert leaf_spec("gate/w", (1,), rules) == P()
+    assert leaf_spec("gate/w", (1, 1), rules) == P()
+
+
+def test_rank1_bias_never_sharded():
+    rules = ((r".*", P("model")),)
+    assert leaf_spec("qkv/bias", (48,), rules) == P()
+    # a rank-2 leaf NAMED bias is not covered by the invariant
+    assert leaf_spec("odd/bias", (8, 8), rules) == P("model")
+
+
+def test_kernel_scale_follows_kernel_output_axis():
+    # column-parallel kernel: (out,) scales ride the same model axis
+    assert leaf_spec("mlp_up/kernel_scale", (64,), DEFAULT_RULES) \
+        == P("model")
+    # row-parallel kernel: output axis unsharded -> scales replicate
+    assert leaf_spec("proj/kernel_scale", (16,), DEFAULT_RULES) == P()
+
+
+def test_unmatched_policy_raise_vs_replicate():
+    rules = ((r"kernel$", P(None, "model")),)
+    with pytest.raises(ValueError, match="no partition rule matched"):
+        match_partition_rules({"odd": {"w": _arr(4, 4)}}, rules)
+    specs = match_partition_rules({"odd": {"w": _arr(4, 4)}}, rules,
+                                  on_unmatched=UNMATCHED_REPLICATE)
+    assert specs["odd"]["w"] == P()
+
+
+def test_rules_json_roundtrip():
+    rules = DEFAULT_RULES + ((r"fused/kernel$", P(("data", "model"), None)),)
+    wire = rules_to_json(rules)
+    json.dumps(wire)  # must be plain-JSON serializable
+    assert rules_from_json(wire) == rules
+
+
+# ---------------------------------------------------------------------------
+# Placement on a real mesh
+# ---------------------------------------------------------------------------
+
+@pytest.mark.requires_env("mp2")
+def test_compatible_spec_demotes_untileable_shapes():
+    mesh = make_mesh(MeshSpec(data=1, model=2), jax.devices()[:2])
+    assert compatible_spec(P(None, "model"), (16, 48), mesh) \
+        == P(None, "model")
+    # rank mismatch, non-divisible dim, unknown axis -> replicated
+    assert compatible_spec(P(None, "model"), (16,), mesh) == P()
+    assert compatible_spec(P(None, "model"), (16, 7), mesh) == P()
+    assert compatible_spec(P(None, "expert"), (16, 48), mesh) == P()
+
+
+@pytest.mark.requires_env("mp2")
+def test_shard_gather_roundtrip_2d_mesh():
+    mesh = make_mesh(MeshSpec(data=2, model=2), jax.devices()[:4])
+    tree = {"qkv": {"kernel": RNG.normal(size=(16, 48)).astype(np.float32)},
+            "proj": {"kernel": RNG.normal(size=(16, 16)).astype(np.float32)},
+            "final_norm_w": {"scale": np.ones(16, np.float32)}}
+    placed = shard_tree(tree, mesh)
+    qkv = placed["qkv"]["kernel"]
+    assert qkv.sharding.spec == P(None, "model")
+    assert not qkv.sharding.is_fully_replicated
+    assert placed["final_norm_w"]["scale"].sharding.is_fully_replicated
+    back = gather_tree(placed, mesh)
+    for path in ("qkv", "proj"):
+        np.testing.assert_array_equal(back[path]["kernel"],
+                                      tree[path]["kernel"])
+        assert isinstance(back[path]["kernel"], np.ndarray)
+
+
+@pytest.mark.requires_env("mp2")
+def test_tree_shardings_always_placeable():
+    mesh = make_mesh(MeshSpec(data=1, model=2), jax.devices()[:2])
+    # an odd output dim the model axis cannot divide demotes to replicated
+    tree = {"mlp_up": {"kernel": _arr(16, 63)}}
+    shardings = tree_shardings(mesh, tree,
+                               on_unmatched=UNMATCHED_REPLICATE)
+    assert shardings["mlp_up"]["kernel"].spec == P()
+    jax.device_put(tree["mlp_up"]["kernel"],
+                   shardings["mlp_up"]["kernel"])  # must not raise
+
+
+@pytest.mark.requires_env("mp2")
+def test_save_bundle_gathers_sharded_leaves_full_shape(tmp_path):
+    """A model-sharded bundle lands on disk with full logical shapes —
+    checkpoints stay portable across dp x mp topologies."""
+    mesh = make_mesh(MeshSpec(data=1, model=2), jax.devices()[:2])
+    module = build_model("TransformerLM", LM_MODEL)
+    bundle = ModelBundle.init(module, (1, 8))
+    host = jax.tree_util.tree_map(np.asarray, bundle.variables)
+    sharded = ModelBundle(
+        bundle.architecture, bundle.config,
+        shard_tree(bundle.variables, mesh,
+                   on_unmatched=UNMATCHED_REPLICATE),
+        {"partition": {"rules": rules_to_json(DEFAULT_RULES),
+                       "mesh": {"data": 1, "model": 2}}})
+    save_bundle(sharded, str(tmp_path / "b"))
+    loaded = load_bundle(str(tmp_path / "b"))
+    assert loaded.partition_rules() == DEFAULT_RULES
+    assert loaded.partition_mesh_shape() == {"data": 1, "model": 2}
+    flat_a = jax.tree_util.tree_leaves_with_path(host)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(loaded.variables))
+    for path, leaf in flat_a:
+        np.testing.assert_array_equal(np.asarray(flat_b[path]),
+                                      np.asarray(leaf))
+
+
+def test_bundle_without_partition_metadata_returns_none():
+    module = build_model("TransformerLM", LM_MODEL)
+    bundle = ModelBundle.init(module, (1, 8))
+    assert bundle.partition_rules() is None
+    assert bundle.partition_mesh_shape() is None
+
+
+# ---------------------------------------------------------------------------
+# Trainer: dp-only checkpoints restore onto dp x mp (and back)
+# ---------------------------------------------------------------------------
+
+def _lm_config(ckpt=None, **kw):
+    base = dict(architecture="TransformerLM", model_config=dict(LM_MODEL),
+                optimizer="adam", learning_rate=1e-2, epochs=1,
+                batch_size=8, loss="softmax_xent", seed=0,
+                shuffle_each_epoch=False, checkpoint_dir=ckpt)
+    base.update(kw)
+    return TrainerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def dp_trainer_run(tmp_path_factory):
+    """One dp=2-trained TransformerLM with its checkpoint directory,
+    shared by the topology-crossing restore assertions."""
+    ckpt = str(tmp_path_factory.mktemp("dp_ckpt"))
+    mesh = make_mesh(MeshSpec(data=2, model=1), jax.devices()[:2])
+    trainer = Trainer(_lm_config(ckpt), mesh=mesh)
+    bundle = trainer.fit_arrays(TOKS, TGTS)
+    return trainer, bundle, ckpt
+
+
+@pytest.mark.budget(120)
+@pytest.mark.requires_env("mp2")
+def test_trained_bundle_records_rules_and_mesh(dp_trainer_run):
+    _, bundle, _ = dp_trainer_run
+    assert bundle.partition_rules() == DEFAULT_RULES
+    assert bundle.partition_mesh_shape() == {"data": 2, "model": 1}
+
+
+@pytest.mark.requires_env("mp2")
+def test_ckpt_meta_records_dp_and_mp(dp_trainer_run):
+    from mmlspark_tpu.resilience.checkpoints import (checkpoint_meta,
+                                                     latest_valid_checkpoint)
+    _, _, ckpt = dp_trainer_run
+    meta = checkpoint_meta(latest_valid_checkpoint(ckpt))
+    assert meta["data_devices"] == 2
+    assert meta["model_devices"] == 1
+
+
+@pytest.mark.budget(120)
+@pytest.mark.requires_env("mp2")
+def test_dp_checkpoint_restores_byte_identical_onto_mp_mesh(dp_trainer_run):
+    """dp=2 save -> dp=2 x mp=2 restore: the live mp state holds byte-
+    identical weights (full-shape payload + put_tree_like onto the new
+    mesh's rule shardings)."""
+    trainer, _, ckpt = dp_trainer_run
+    mesh = make_mesh(MeshSpec(data=2, model=2), jax.devices()[:4])
+    t2 = Trainer(_lm_config(), mesh=mesh)
+    state2 = t2.init_state((8, 12), input_dtype=np.int32)
+    qkv = state2.params["block0_w"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == P(None, "model")  # registry layout live
+    restored = t2.restore_checkpoint(state2, ckpt)
+    src = trainer._last_state
+    assert int(restored.step) == int(src.step)
+    for a, b in zip(jax.tree_util.tree_leaves(src.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the restored leaves keep the mp mesh's rule shardings
+    assert restored.params["block0_w"]["qkv"]["kernel"].sharding.spec \
+        == P(None, "model")
+
+
+@pytest.mark.budget(180)
+@pytest.mark.requires_env("mp2")
+def test_mp_checkpoint_restores_byte_identical_onto_dp_mesh(tmp_path):
+    """The reverse crossing: mp=2 save -> dp-only restore."""
+    ckpt = str(tmp_path / "mp_ckpt")
+    mesh = make_mesh(MeshSpec(data=2, model=2), jax.devices()[:4])
+    t1 = Trainer(_lm_config(ckpt), mesh=mesh)
+    t1.fit_arrays(TOKS, TGTS)
+    from mmlspark_tpu.resilience.checkpoints import (checkpoint_meta,
+                                                     latest_valid_checkpoint)
+    meta = checkpoint_meta(latest_valid_checkpoint(ckpt))
+    assert (meta["data_devices"], meta["model_devices"]) == (2, 2)
+    t2 = Trainer(_lm_config(),
+                 mesh=make_mesh(MeshSpec(data=2, model=1),
+                                jax.devices()[:2]))
+    state2 = t2.init_state((8, 12), input_dtype=np.int32)
+    restored = t2.restore_checkpoint(state2, ckpt)
+    for a, b in zip(jax.tree_util.tree_leaves(t1._last_state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.budget(180)
+@pytest.mark.requires_env("mp2")
+def test_elastic_resume_crosses_dp_to_mp(dp_trainer_run, tmp_path):
+    """resume=True onto a dp x mp mesh keeps training (reshard-on-
+    restore): the resumed run continues the saved step count."""
+    _, bundle, ckpt = dp_trainer_run
+    # resume writes new (dp=2 x mp=2) checkpoints; work on a copy so the
+    # module-shared dp-only directory keeps its saved topology
+    ckpt_copy = str(tmp_path / "dp_ckpt_copy")
+    shutil.copytree(ckpt, ckpt_copy)
+    mesh = make_mesh(MeshSpec(data=2, model=2), jax.devices()[:4])
+    t2 = Trainer(_lm_config(epochs=2), mesh=mesh)
+    out = t2.fit_arrays(TOKS, TGTS, resume=True, ckpt_dir=ckpt_copy)
+    assert out.metadata["steps"] > bundle.metadata["steps"]
+    assert out.partition_mesh_shape() == {"data": 2, "model": 2}
+
+
+@pytest.mark.requires_env("mp2")
+def test_pipeline_restore_rejects_stage_count_change(dp_trainer_run):
+    """The one non-elastic axis: a pipeline trainer refuses a checkpoint
+    written under a different stage count, naming both topologies."""
+    _, _, ckpt = dp_trainer_run
+    mesh = make_mesh(MeshSpec(data=2, model=2), jax.devices()[:4])
+    cfg = _lm_config(pipeline_stages=2, pipeline_microbatches=2)
+    t = Trainer(cfg, mesh=mesh)
+    with pytest.raises(ValueError) as err:
+        t.fit_arrays(TOKS, TGTS, resume=True, ckpt_dir=ckpt)
+    msg = str(err.value)
+    assert "dp=2 x mp=1" in msg and "dp=2 x mp=2" in msg
+    assert "stage count" in msg
+
+
+# ---------------------------------------------------------------------------
+# Scoring and decode at mp=2
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm_bundle():
+    module = build_model("TransformerLM", LM_MODEL)
+    return ModelBundle.init(module, (1, 12), seed=3)
+
+
+@pytest.mark.requires_env("mp2")
+def test_mp_scoring_matches_single_device(lm_bundle):
+    table = DataTable({"tokens": TOKS})
+    plain = TPUModel(lm_bundle, inputCol="tokens", outputCol="scores",
+                     miniBatchSize=8).transform(table)["scores"]
+    mesh = make_mesh(MeshSpec(data=2, model=2), jax.devices()[:4])
+    scorer = TPUModel(lm_bundle, inputCol="tokens", outputCol="scores",
+                      miniBatchSize=8).set_mesh(mesh)
+    sharded = scorer.transform(table)["scores"]
+    assert sharded.shape == plain.shape
+    np.testing.assert_allclose(sharded, plain, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.requires_env("mp2")
+def test_mp_greedy_decode_token_parity(lm_bundle):
+    from mmlspark_tpu.models.generate import DecodeEngine
+
+    module = lm_bundle.module()
+    prompts = np.zeros((4, 8), np.int32)
+    prompts[:, :5] = RNG.integers(1, 32, (4, 5))
+    tl = np.full(4, 5, np.int32)
+    ref = DecodeEngine(module, 6).generate(lm_bundle.variables, prompts, tl)
+    mesh = make_mesh(MeshSpec(data=2, model=2), jax.devices()[:4])
+    vars_mp = shard_tree(lm_bundle.variables, mesh,
+                         on_unmatched=UNMATCHED_REPLICATE)
+    got = DecodeEngine(module, 6, mesh=mesh).generate(vars_mp, prompts, tl)
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.requires_env("mp2")
+def test_textgenerator_set_mesh_shards_weights(lm_bundle):
+    from mmlspark_tpu.models.generate import TextGenerator
+
+    mesh = make_mesh(MeshSpec(data=2, model=2), jax.devices()[:4])
+    gen = TextGenerator(lm_bundle, inputCol="prompt", outputCol="out",
+                        maxNewTokens=4).set_mesh(mesh)
+    variables = gen._device_variables()
+    qkv = variables["params"]["block0_w"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == P(None, "model")
+    rows = [RNG.integers(1, 32, 6).astype(np.int32) for _ in range(3)]
+    out = gen.transform(DataTable({"prompt": rows}))["out"]
+    plain = TextGenerator(lm_bundle, inputCol="prompt", outputCol="out",
+                          maxNewTokens=4).transform(
+        DataTable({"prompt": rows}))["out"]
+    for a, b in zip(out, plain):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

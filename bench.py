@@ -844,6 +844,18 @@ def bench_lm_decode(smoke: bool) -> dict:
     3. RAGGED workload (TextGenerator.transform): >= 8 distinct prompt
        lengths through the bucketed engine — compiled-program count (was
        one per length), tokens/sec, and prefill/decode span attribution.
+    4. SPECULATIVE decoding: a layer-truncated self-draft
+       (zoo/speculative.py) proposes k tokens per round against a
+       draft-friendly target (late blocks softened so acceptance is
+       high); tokens/sec vs the non-speculative engine at PINNED
+       byte-identical greedy outputs, plus acceptance rate and
+       accepted-tokens-per-round.  The speedup is measured, never
+       assumed — speculation that loses on this hardware reports < 1.
+    5. CHUNKED PREFILL serving: first-token latency of a short request
+       that arrives right behind a long prompt, whole-prompt prefill vs
+       chunked (one chunk per scheduler tick) — the serve-path
+       stall-behind-new-arrivals claim, measured on a live
+       ServingEngine.
     """
     import jax
     import jax.numpy as jnp
@@ -995,6 +1007,93 @@ def bench_lm_decode(smoke: bool) -> dict:
     rag_tokens = len(rag_rows) * ragged_new
     span_summary = spans.summary()
 
+    # -- arm 4: speculative decoding vs its own non-spec baseline -------
+    # its own model: deep enough that a 1-layer self-draft is cheap
+    # relative to the target (the regime speculation exists for); late
+    # blocks softened to zero so the draft agrees on nearly every greedy
+    # token and the measured speedup is stable across seeds
+    from mmlspark_tpu.zoo import soften_late_blocks, truncated_draft_bundle
+    if smoke:
+        s_cfg = {"vocab_size": 256, "d_model": 512, "n_heads": 4,
+                 "n_layers": 6, "max_len": 128}
+        s_b, s_p, s_new, s_k, s_chunk = 2, 8, 64, 7, 16
+    else:
+        s_cfg = {"vocab_size": 8192, "d_model": 1024, "n_heads": 8,
+                 "n_layers": 8, "max_len": 512}
+        s_b, s_p, s_new, s_k, s_chunk = 8, 64, 128, 7, 128
+    s_model = build_model("TransformerLM", s_cfg)
+    s_bundle = soften_late_blocks(
+        ModelBundle.init(s_model, (1, s_p)), 1, factor=0.0)
+    s_draft = truncated_draft_bundle(s_bundle, 1)
+    s_prompts = rng.integers(0, s_cfg["vocab_size"], (s_b, s_p)).astype(
+        np.int32)
+    s_true = np.full(s_b, s_p, np.int32)
+    s_base = DecodeEngine(s_model, s_new, chunk=s_chunk)
+    s_ref = s_base.generate(s_bundle.variables, s_prompts, s_true)
+    s_eng = DecodeEngine(s_model, s_new, chunk=s_chunk,
+                         draft_module=s_draft.module(), spec_tokens=s_k)
+    s_got = s_eng.generate(s_bundle.variables, s_prompts, s_true,
+                           draft_variables=s_draft.variables)
+    spec_identical = bool(np.array_equal(s_ref, s_got))
+    base_best = spec_best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        s_base.generate(s_bundle.variables, s_prompts, s_true)
+        base_best = min(base_best, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        s_eng.generate(s_bundle.variables, s_prompts, s_true,
+                       draft_variables=s_draft.variables)
+        spec_best = min(spec_best, time.perf_counter() - t0)
+    spec_base_tps = s_b * s_new / base_best
+    spec_tps = s_b * s_new / spec_best
+    spec_rounds = max(1, s_eng.last_spec_rounds)
+
+    # -- arm 5: chunked-prefill first-token latency on a live engine ----
+    from mmlspark_tpu.observe.spans import monotonic as _mono
+    from mmlspark_tpu.serve.engine import ServeConfig, ServingEngine
+    if smoke:
+        c_cfg = {"vocab_size": 256, "d_model": 256, "n_heads": 4,
+                 "n_layers": 4, "max_len": 512}
+        c_chunk, c_long, c_short, c_new = 32, 224, 8, 16
+    else:
+        c_cfg = {"vocab_size": 8192, "d_model": 1024, "n_heads": 8,
+                 "n_layers": 4, "max_len": 1024}
+        c_chunk, c_long, c_short, c_new = 128, 896, 32, 32
+    c_model = build_model("TransformerLM", c_cfg)
+    c_bundle = ModelBundle.init(c_model, (1, 8))
+    long_p = rng.integers(1, c_cfg["vocab_size"], c_long).tolist()
+    short_p = rng.integers(1, c_cfg["vocab_size"], c_short).tolist()
+    resident_p = rng.integers(1, c_cfg["vocab_size"], c_short - 1).tolist()
+
+    def first_token_ms(prefill_chunk: int) -> float:
+        sc = ServeConfig(
+            max_new_tokens=c_new, max_batch=4, queue_capacity=16,
+            segment_steps=4, cache_chunk=c_chunk,
+            prefill_chunk=prefill_chunk, default_deadline_s=600.0,
+            warmup_buckets=(serve_eng0.bucket_for(c_short),
+                            serve_eng0.bucket_for(c_long)))
+        eng = ServingEngine(c_bundle, sc).warmup()
+        r0 = eng.submit(resident_p)     # decode already in flight
+        eng._tick()
+        lg = eng.submit(long_p)         # the stall: a long prompt...
+        sh = eng.submit(short_p)        # ...with a short one right behind
+        t0 = _mono()
+        first = None
+        for _ in range(400):
+            eng._tick()
+            if first is None and len(sh.tokens) > 0:
+                first = _mono() - t0
+            if sh.finished and lg.finished and r0.finished:
+                break
+        assert lg.status == "ok" and sh.status == "ok", \
+            (lg.status, sh.status)
+        return first * 1e3
+
+    serve_eng0 = DecodeEngine(c_model, c_new, chunk=c_chunk)
+    whole_ft_ms = first_token_ms(0)
+    chunked_ft_ms = first_token_ms(c_chunk)
+    prefill_chunks = serve_eng0.bucket_for(c_long) // c_chunk
+
     return {
         "metric": "transformer_lm_decode_tokens_per_sec_per_chip",
         "value": round(decode_tps, 1),
@@ -1032,6 +1131,24 @@ def bench_lm_decode(smoke: bool) -> dict:
         "ragged_tokens_per_sec": round(rag_tokens / rag_wall, 1),
         "stage_prefill_s": span_summary.get("stage_prefill_s", 0.0),
         "stage_decode_s": span_summary.get("stage_decode_s", 0.0),
+        # speculative arm: tokens/sec vs the non-spec engine at pinned
+        # byte-identical greedy outputs (its own deeper model — see arm 4)
+        "spec_k": s_k,
+        "spec_byte_identical": spec_identical,
+        "spec_acceptance_rate": round(s_eng.last_spec_acceptance, 4),
+        "spec_accepted_per_round": round(
+            s_eng.last_spec_accepted / spec_rounds / s_b, 3),
+        "spec_base_tokens_per_sec": round(spec_base_tps, 1),
+        "spec_tokens_per_sec": round(spec_tps, 1),
+        "spec_speedup": round(spec_tps / spec_base_tps, 3)
+        if spec_base_tps > 0 else None,
+        # chunked-prefill arm: first-token latency of a short request
+        # arriving right behind a long prompt, whole vs chunked prefill
+        "prefill_chunks": prefill_chunks,
+        "whole_prefill_first_token_ms": round(whole_ft_ms, 2),
+        "chunked_prefill_first_token_ms": round(chunked_ft_ms, 2),
+        "chunked_prefill_speedup": round(whole_ft_ms / chunked_ft_ms, 3)
+        if chunked_ft_ms > 0 else None,
     }
 
 

@@ -84,6 +84,8 @@ from mmlspark_tpu.observe.spans import active_timings, span_on
 from mmlspark_tpu.observe.telemetry import active_run
 from mmlspark_tpu.observe.trace import trace_event, trace_span
 from mmlspark_tpu.parallel.partition import (
+    DRAFT_KV_CACHE_SPEC,
+    DRAFT_KV_SCALE_SPEC,
     KV_CACHE_SPEC,
     KV_SCALE_SPEC,
     shard_constraint,
@@ -91,6 +93,15 @@ from mmlspark_tpu.parallel.partition import (
 )
 
 NEG_INF = -1e30
+
+# Speculative-decoding RNG streams: disjoint fold_in offsets keep draft
+# draws, acceptance coins, and residual/bonus draws off the non-spec
+# per-step streams (fold_in(row_key, step), step < max_new_tokens).  A
+# row's speculative draws depend only on (its key, round, position) —
+# never on batch composition — matching the engine's sampling contract.
+_SPEC_DRAFT_STREAM = 1 << 20
+_SPEC_COIN_STREAM = 2 << 20
+_SPEC_FIX_STREAM = 3 << 20
 
 
 def _hint_kv(c: jax.Array) -> jax.Array:
@@ -102,6 +113,18 @@ def _hint_kv(c: jax.Array) -> jax.Array:
         return shard_constraint(c, KV_CACHE_SPEC)
     if c.ndim == 3:
         return shard_constraint(c, KV_SCALE_SPEC)
+    return c
+
+
+def _hint_draft_kv(c: jax.Array) -> jax.Array:
+    """`_hint_kv` for the DRAFT model's cache: batch on 'data', heads
+    replicated (DRAFT_KV_CACHE_SPEC — a latency-sized draft rarely has a
+    head count the model axis divides, and its forward is a rounding
+    error next to the target's)."""
+    if c.ndim == 4:
+        return shard_constraint(c, DRAFT_KV_CACHE_SPEC)
+    if c.ndim == 3:
+        return shard_constraint(c, DRAFT_KV_SCALE_SPEC)
     return c
 
 
@@ -549,8 +572,25 @@ def _quantize_cache(kc: jax.Array, vc: jax.Array) -> tuple:
     return kq, ks, vq, vs
 
 
+def _sq_attention(fused: bool):
+    """The decode step's cache read.  `fused=True` routes through the
+    Pallas single-query kernel (ops/decode_attention.py) — which itself
+    degrades to the XLA reference off-TPU or on shapes it can't tile, so
+    tier-1 CPU runs exercise the fallback on the product path.  The
+    engine only requests it single-device: `pallas_call` carries no SPMD
+    partitioning rule, so under a mesh the decode step keeps the einsum
+    composition GSPMD can shard."""
+    if fused:
+        from mmlspark_tpu.ops.decode_attention import (
+            fused_single_query_attention)
+        return fused_single_query_attention
+    from mmlspark_tpu.ops.attention import single_query_attention
+    return single_query_attention
+
+
 def _decode_block(module, bp: dict, x: jax.Array, cache: tuple,
-                  slot, visible, dtype, cache_kind: str):
+                  slot, visible, dtype, cache_kind: str,
+                  fused: bool = False):
     """One TransformerBlock for a single decode token: write K/V at cache
     `slot` (shared across rows — decode slots sit after the bucket's pad
     tail), attend under the per-row `visible` mask (true-prompt slots plus
@@ -559,9 +599,11 @@ def _decode_block(module, bp: dict, x: jax.Array, cache: tuple,
     `cache` is (k, v) for a model-dtype cache or (k_q, k_scale, v_q,
     v_scale) for an int8 one (cache_kind 'int8'): the new token's K/V are
     quantized per-head ON WRITE and the attention read dequantizes inside
-    `single_query_attention` — the steady step streams 1 byte per cached
-    element instead of the model dtype's 2-4."""
-    from mmlspark_tpu.ops.attention import single_query_attention
+    the cache attention (`_sq_attention`: the fused Pallas kernel on a
+    single TPU device, `single_query_attention` otherwise) — the steady
+    step streams 1 byte per cached element instead of the model dtype's
+    2-4."""
+    single_query_attention = _sq_attention(fused)
     n_heads = module.n_heads
     b, s, d = x.shape
     dh = d // n_heads
@@ -596,7 +638,8 @@ def _decode_block(module, bp: dict, x: jax.Array, cache: tuple,
 
 
 def _decode_step(params: dict, tok: jax.Array, pos: jax.Array, slot,
-                 caches: list, visible, module, cache_kind: str = "model"):
+                 caches: list, visible, module, cache_kind: str = "model",
+                 fused: bool = False):
     """Logits (B, V) for one decode token per row: per-row positions `pos`
     (true prompt length + step — NOT the shared cache slot), shared write
     `slot`, per-row attention visibility."""
@@ -608,7 +651,7 @@ def _decode_step(params: dict, tok: jax.Array, pos: jax.Array, slot,
     for i in range(module.n_layers):
         x, cache = _decode_block(module, params[f"block{i}_w"], x,
                                  caches[i], slot, visible, dtype,
-                                 cache_kind)
+                                 cache_kind, fused)
         new_caches.append(cache)
     x = _ln(params["final_norm_w"], x, dtype)
     logits = _dense(params["lm_head"], x, dtype).astype(jnp.float32)
@@ -617,9 +660,11 @@ def _decode_step(params: dict, tok: jax.Array, pos: jax.Array, slot,
 
 def _row_write(cache: jax.Array, update: jax.Array,
                slots: jax.Array) -> jax.Array:
-    """Write one new entry per row at a PER-ROW slot: vmap of the
-    single-row dynamic_update_slice over the batch axis.  `cache`
-    (B, W, ...), `update` (B, 1, ...), `slots` (B,) int32.  The serving
+    """Write a contiguous block of new entries per row at a PER-ROW
+    start slot: vmap of the single-row dynamic_update_slice over the
+    batch axis.  `cache` (B, W, ...), `update` (B, S, ...) — S is 1 for
+    decode steps, the verify segment length for speculative decoding —
+    `slots` (B,) int32 start positions.  The serving
     engine's continuous batch needs this — joined rows sit at different
     decode offsets, so the uniform shared-slot write of `_decode_block`
     no longer applies.  dynamic_update_slice clamps starts, so a frozen
@@ -632,12 +677,13 @@ def _row_write(cache: jax.Array, update: jax.Array,
 
 
 def _decode_block_rows(module, bp: dict, x: jax.Array, cache: tuple,
-                       slots, visible, dtype, cache_kind: str):
+                       slots, visible, dtype, cache_kind: str,
+                       fused: bool = False):
     """`_decode_block` with PER-ROW write slots (serving engine): row r
     writes its K/V at `slots[r]` instead of one shared slot.  Math and
     cache layouts are identical otherwise — same quantize-on-write int8
-    discipline, same `single_query_attention` read."""
-    from mmlspark_tpu.ops.attention import single_query_attention
+    discipline, same cache-attention read (`_sq_attention`)."""
+    single_query_attention = _sq_attention(fused)
     n_heads = module.n_heads
     b, s, d = x.shape
     dh = d // n_heads
@@ -671,7 +717,7 @@ def _decode_block_rows(module, bp: dict, x: jax.Array, cache: tuple,
 
 def _decode_step_rows(params: dict, tok: jax.Array, pos: jax.Array, slots,
                       caches: list, visible, module,
-                      cache_kind: str = "model"):
+                      cache_kind: str = "model", fused: bool = False):
     """`_decode_step` with per-row write `slots` (B,) — the continuous-
     batching decode step.  `pos` stays per-row true positions; callers
     clamp it below max_len for frozen rows (their output is masked by
@@ -684,11 +730,80 @@ def _decode_step_rows(params: dict, tok: jax.Array, pos: jax.Array, slots,
     for i in range(module.n_layers):
         x, cache = _decode_block_rows(module, params[f"block{i}_w"], x,
                                       caches[i], slots, visible, dtype,
-                                      cache_kind)
+                                      cache_kind, fused)
         new_caches.append(cache)
     x = _ln(params["final_norm_w"], x, dtype)
     logits = _dense(params["lm_head"], x, dtype).astype(jnp.float32)
     return logits[:, 0], new_caches
+
+
+def _verify_block_rows(module, bp: dict, x: jax.Array, cache: tuple,
+                       slots0, visible, dtype, cache_kind: str):
+    """One TransformerBlock over a row's CONTIGUOUS S-token verify
+    segment (speculative decoding): row r writes S new K/V entries at
+    slots0[r]..slots0[r]+S-1 in one per-row block write (`_row_write`
+    takes any update length), then attends all S queries against the
+    cache window under per-QUERY visibility
+    (ops/attention.segment_cache_attention).  Same quantize-on-write
+    int8 discipline as `_decode_block_rows`; at S = 1 the attention math
+    is elementwise-identical to the single-query step — the property the
+    speculative path's greedy byte-exactness rests on."""
+    from mmlspark_tpu.ops.attention import segment_cache_attention
+    n_heads = module.n_heads
+    b, s, d = x.shape
+    dh = d // n_heads
+    h = _ln(bp["LayerNorm_0"], x, dtype)
+    qkv = _dense(bp["qkv"], h, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, s, n_heads, dh)
+    q, k, v = (t.reshape(shape) for t in (q, k, v))
+    if cache_kind == "int8":
+        from mmlspark_tpu.quant.quantize import quantize_kv
+        kq, ks, vq, vs = cache
+        k8, k8s = quantize_kv(k)
+        v8, v8s = quantize_kv(v)
+        kq = _row_write(kq, k8, slots0)
+        ks = _row_write(ks, k8s, slots0)
+        vq = _row_write(vq, v8, slots0)
+        vs = _row_write(vs, v8s, slots0)
+        o = segment_cache_attention(q, kq, vq, visible,
+                                    k_scale=ks, v_scale=vs)
+        cache = (kq, ks, vq, vs)
+    else:
+        k_cache, v_cache = cache
+        k_cache = _row_write(k_cache, k.astype(k_cache.dtype), slots0)
+        v_cache = _row_write(v_cache, v.astype(v_cache.dtype), slots0)
+        o = segment_cache_attention(q, k_cache, v_cache, visible)
+        cache = (k_cache, v_cache)
+    x = x + _dense(bp["proj"], o.reshape(b, s, d).astype(dtype), dtype)
+    h2 = _ln(bp["LayerNorm_1"], x, dtype)
+    return x + _mlp(module, bp, h2, dtype), cache
+
+
+def _verify_step_rows(params: dict, toks: jax.Array, pos0: jax.Array,
+                      slots0, caches: list, visible, module,
+                      cache_kind: str = "model"):
+    """Logits (B, S, V) for per-row contiguous verify segments — the
+    speculative-decoding target forward: ONE program scores every drafted
+    position.  Row r's S tokens sit at positions pos0[r]..pos0[r]+S-1
+    (clamped to the position table) and write cache slots
+    slots0[r]..slots0[r]+S-1; `visible` is per-query (B, S, W)."""
+    dtype = module.dtype
+    s = toks.shape[1]
+    positions = pos0[:, None] + jnp.arange(s)[None, :]
+    positions = jnp.minimum(positions, module.max_len - 1)
+    emb = (params["tok_embed"]["embedding"][toks]
+           + params["pos_embed"]["embedding"][positions])
+    x = emb.astype(dtype)
+    new_caches = []
+    for i in range(module.n_layers):
+        x, cache = _verify_block_rows(module, params[f"block{i}_w"], x,
+                                      caches[i], slots0, visible, dtype,
+                                      cache_kind)
+        new_caches.append(cache)
+    x = _ln(params["final_norm_w"], x, dtype)
+    logits = _dense(params["lm_head"], x, dtype).astype(jnp.float32)
+    return logits, new_caches
 
 
 def _grow_cache(cache: jax.Array, window: int) -> jax.Array:
@@ -750,7 +865,10 @@ class DecodeEngine:
                  stop_tokens: tuple = (),
                  chunk: int = DEFAULT_CACHE_CHUNK,
                  min_bucket: int = DEFAULT_MIN_BUCKET,
-                 cache_dtype: str = "model", mesh=None):
+                 cache_dtype: str = "model", mesh=None,
+                 min_new_tokens: int = 1,
+                 prefill_chunk: Optional[int] = None,
+                 draft_module=None, spec_tokens: int = 0):
         _check_generatable(module)
         if cache_dtype not in ("model", "int8"):
             raise ValueError(
@@ -767,6 +885,39 @@ class DecodeEngine:
             raise ValueError("top_p must be in (0, 1]")
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
+        if not 1 <= min_new_tokens <= max_new_tokens:
+            raise ValueError(
+                f"min_new_tokens ({min_new_tokens}) must be in "
+                f"1..max_new_tokens ({max_new_tokens})")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                "prefill_chunk must be >= 1 (None = whole-prompt)")
+        if spec_tokens < 0:
+            raise ValueError("spec_tokens must be >= 0")
+        if spec_tokens and draft_module is None:
+            raise ValueError(
+                "spec_tokens > 0 needs a draft_module (zoo/speculative.py "
+                "builds one from a target bundle)")
+        if draft_module is not None:
+            if spec_tokens < 1:
+                raise ValueError("draft_module set but spec_tokens is 0")
+            _check_generatable(draft_module)
+            if draft_module.vocab_size != module.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({draft_module.vocab_size}) != target "
+                    f"vocab ({module.vocab_size}): speculative acceptance "
+                    "compares distributions over one vocabulary")
+            if draft_module.max_len < module.max_len:
+                raise ValueError(
+                    f"draft max_len ({draft_module.max_len}) < target "
+                    f"max_len ({module.max_len}): the draft must reach "
+                    "every position the target decodes")
+            if module.mlp_impl == "moe" or draft_module.mlp_impl == "moe":
+                raise ValueError(
+                    "speculative decoding does not support MoE models: "
+                    "the multi-token verify forward routes a different "
+                    "capacity group than step-by-step decode, so "
+                    "greedy-exactness cannot hold (see _mlp)")
         stop_tokens = tuple(int(t) for t in stop_tokens or ())
         for t in stop_tokens:
             if not 0 <= t < module.vocab_size:
@@ -779,15 +930,35 @@ class DecodeEngine:
         self.chunk = chunk
         self.min_bucket = min_bucket
         self.cache_dtype = cache_dtype
+        self.min_new_tokens = min_new_tokens
+        self.prefill_chunk = prefill_chunk
+        self.draft_module = draft_module
+        self.spec_tokens = spec_tokens
         # the mesh the KV hints target: every compiled program (prefill,
         # segments, merge) traces under use_mesh(mesh), so at mp >= 2 the
         # cache keeps heads on 'model' end to end; None = single-device
         self.mesh = mesh
+        # the fused Pallas single-query kernel only runs single-device:
+        # pallas_call has no SPMD partitioning rule, so under a mesh the
+        # decode step keeps the einsum composition GSPMD can shard.  (The
+        # kernel itself degrades to the same reference off-TPU — tier-1
+        # CPU runs exercise that fallback on this very path.)
+        fused = mesh is None
+        self.uses_fused_attention = fused
         greedy = temperature <= 0.0
         sample = _make_sampler(temperature,
                                None if greedy else top_k,
                                None if greedy else top_p)
         is_stop = _make_stop_check(stop_tokens)
+        min_new = min_new_tokens
+
+        def stop_gate(tok, new_count):
+            # a stop token only freezes once the row has emitted
+            # `min_new_tokens` tokens INCLUDING it; `new_count` is that
+            # count (a python int at prefill, traced in segment scans)
+            if min_new <= 1:
+                return is_stop(tok)
+            return is_stop(tok) & (new_count >= min_new)
 
         def prefill_impl(variables, prompts, true_len, live, row_keys):
             params = variables["params"]
@@ -804,7 +975,7 @@ class DecodeEngine:
             last = jnp.take_along_axis(
                 logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
             tok = sample(last, row_keys, 0)
-            done = ~live | is_stop(tok)
+            done = ~live | stop_gate(tok, 1)
             if cache_dtype == "int8":
                 # quantize-on-write at prefill granularity: the prompt's
                 # whole cache quantizes once here, decode steps quantize
@@ -831,10 +1002,10 @@ class DecodeEngine:
                               & (slots[None, :] <= slot)))
                 logits, caches = _decode_step(params, tok, pos, slot,
                                               caches, visible, module,
-                                              cache_dtype)
+                                              cache_dtype, fused)
                 nxt = sample(logits, row_keys, t + 1)
                 nxt = jnp.where(done, tok, nxt)
-                return (nxt, done | is_stop(nxt), caches), tok
+                return (nxt, done | stop_gate(nxt, t + 2), caches), tok
 
             (tok, done, caches), toks = lax.scan(
                 step, (tok, done, caches), jnp.arange(seg_len))
@@ -871,15 +1042,253 @@ class DecodeEngine:
                               & (slots_axis[None, :] <= slot[:, None])))
                 logits, caches = _decode_step_rows(
                     params, tok, pos, slot, caches, visible, module,
-                    cache_dtype)
+                    cache_dtype, fused)
                 nxt = row_sample(logits, row_keys, t + 1)
                 nxt = jnp.where(done, tok, nxt)
-                done = done | is_stop(nxt) | (t + 1 >= budget)
+                done = done | stop_gate(nxt, t + 2) | (t + 1 >= budget)
                 return (nxt, done, caches), nxt
 
             (tok, done, caches), toks = lax.scan(
                 step, (tok, done, caches), jnp.arange(seg_len))
             return caches, toks.transpose(1, 0), tok, done
+
+        def prefill_chunk0_impl(w0, variables, tokens, true_len):
+            """First chunk of a CHUNKED prefill (offset 0): allocates the
+            window-`w0` caches and seeds the running last-prompt-position
+            logits.  Chunking splits the prompt forward so the serving
+            engine can interleave it with resident decode segments — a
+            long prompt stops stalling running requests."""
+            params = variables["params"]
+            b, cl = tokens.shape
+            dh = module.d_model // module.n_heads
+            caches = [(_hint_kv(jnp.zeros((b, w0, module.n_heads, dh),
+                                          module.dtype)),
+                       _hint_kv(jnp.zeros((b, w0, module.n_heads, dh),
+                                          module.dtype)))
+                      for _ in range(module.n_layers)]
+            logits, caches = _forward_with_cache(params, tokens, caches,
+                                                 0, module)
+            idx = jnp.clip(true_len - 1, 0, cl - 1)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]
+            return caches, last
+
+        def prefill_chunk_impl(variables, tokens, caches, last, true_len,
+                               c0):
+            """One later prompt chunk at TRACED offset `c0`: the dense
+            `_block_with_cache` path works at any position, so every
+            chunk index shares ONE compiled program per shape class.
+            Rows whose last prompt token falls inside this chunk update
+            the running last-position logits."""
+            params = variables["params"]
+            cl = tokens.shape[1]
+            logits, caches = _forward_with_cache(params, tokens, caches,
+                                                 c0, module)
+            idx = jnp.clip(true_len - 1 - c0, 0, cl - 1)
+            cand = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]
+            here = (true_len - 1 >= c0) & (true_len - 1 < c0 + cl)
+            last = jnp.where(here[:, None], cand, last)
+            return caches, last
+
+        def prefill_finish_impl(caches, last, live, row_keys):
+            """Close a chunked prefill: sample the first token and (int8
+            mode) quantize the whole prompt cache — the same
+            (tok, done, caches) contract as `prefill_impl`."""
+            tok = sample(last, row_keys, 0)
+            done = ~live | stop_gate(tok, 1)
+            if cache_dtype == "int8":
+                caches = [tuple(_hint_kv(c)
+                                for c in _quantize_cache(kc, vc))
+                          for kc, vc in caches]
+            return tok, done, caches
+
+        def draft_prefill_impl(draft_variables, prompts):
+            """Prefill the DRAFT model's cache over the prompt
+            (speculative decoding) — same window arithmetic as the
+            target prefill, no sampling: the draft's first proposal
+            comes from its first round step.  Draft caches stay
+            model-dtype (the draft is latency-sized; int8's bandwidth
+            win is a target-cache story) and replicate their heads under
+            a mesh (DRAFT_KV_CACHE_SPEC)."""
+            dm = draft_module
+            params = draft_variables["params"]
+            b, p = prompts.shape
+            w0 = _round_up(p + 1, chunk)
+            dh = dm.d_model // dm.n_heads
+            caches = [(_hint_draft_kv(jnp.zeros((b, w0, dm.n_heads, dh),
+                                                dm.dtype)),
+                       _hint_draft_kv(jnp.zeros((b, w0, dm.n_heads, dh),
+                                                dm.dtype)))
+                      for _ in range(dm.n_layers)]
+            _, caches = _forward_with_cache(params, prompts, caches, 0,
+                                            dm)
+            return caches
+
+        k_spec = spec_tokens
+
+        def spec_round_impl(window, variables, draft_variables, caches,
+                            draft_caches, tok, done, true_len, budget,
+                            bucket, t_row, round_idx, row_keys):
+            """One speculative round over a mixed-age batch (generate()
+            and the serving engine share this program): the draft model
+            proposes `spec_tokens` tokens with k+1 cheap single-token
+            steps (the extra step back-fills the last proposal's
+            draft-cache slot, so the draft never attends a zero slot),
+            ONE target forward scores every proposal
+            (`_verify_step_rows`), and the agreeing prefix commits.
+
+            Greedy mode accepts while the proposal equals the target
+            argmax and appends the target's own next token — the
+            committed stream IS the target's greedy chain by
+            construction.  Sampler mode runs standard rejection
+            sampling: accept d ~ q(draft) with probability min(1,
+            p(d)/q(d)); on rejection draw from the residual
+            max(p - q, 0)/Z — each committed token is distributed
+            exactly as a target-model draw, whatever the draft proposes.
+
+            Rejected proposals leave garbage K/V past a row's committed
+            frontier; visibility is strictly causal in committed slots,
+            so those bytes are never read, and the next round overwrites
+            them in order.  Returns (caches, draft_caches,
+            toks (B, k+1), counts (B,), tok, done, accepted (B,)):
+            `counts[r]` leading entries of row r's `toks` are real
+            committed tokens (the rest repeat the frozen token);
+            `accepted` is the raw draft/target agreement length for
+            acceptance-rate telemetry."""
+            params = variables["params"]
+            dparams = draft_variables["params"]
+            caches = [tuple(_hint_kv(_grow_cache(c, window))
+                            for c in layer) for layer in caches]
+            draft_caches = [tuple(_hint_draft_kv(_grow_cache(c, window))
+                                  for c in layer)
+                            for layer in draft_caches]
+            b = tok.shape[0]
+            s = k_spec + 1
+            slots_axis = jnp.arange(window)
+            max_pos = module.max_len - 1
+            sampling = not greedy
+
+            # -- draft: k+1 single-token steps (proposals from the first
+            # k; the last only writes K/V so the draft cache covers
+            # every slot its next round will attend) --
+            d_toks = []
+            d_dists = []
+            cur = tok
+            for j in range(s):
+                t = t_row + j
+                slot = jnp.minimum(bucket + t, window - 1)
+                pos = jnp.minimum(true_len + t, max_pos)
+                visible = ((slots_axis[None, :] < true_len[:, None])
+                           | ((slots_axis[None, :] >= bucket)
+                              & (slots_axis[None, :] <= slot[:, None])))
+                dlogits, draft_caches = _decode_step_rows(
+                    dparams, cur, pos, slot, draft_caches, visible,
+                    draft_module, "model")
+                if j == k_spec:
+                    break          # K/V back-fill only; proposal unused
+                if sampling:
+                    fd = filter_logits(dlogits / temperature, top_k,
+                                       top_p)
+                    keys = jax.vmap(
+                        lambda rk, jj=j: jax.random.fold_in(
+                            jax.random.fold_in(
+                                rk, _SPEC_DRAFT_STREAM + round_idx),
+                            jj))(row_keys)
+                    nxt = jax.vmap(jax.random.categorical)(
+                        keys, fd).astype(jnp.int32)
+                    d_dists.append(jax.nn.softmax(fd, axis=-1))
+                else:
+                    nxt = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                d_toks.append(nxt)
+                cur = nxt
+            d = jnp.stack(d_toks, axis=1)                       # (B, k)
+
+            # -- verify: one target forward over [tok, d_1..d_k]; token
+            # index t_row+j's K/V lands at slot bucket+t_row+j, the same
+            # invariant the per-step path keeps --
+            xs = jnp.concatenate([tok[:, None], d], axis=1)     # (B, S)
+            slots0 = jnp.minimum(bucket + t_row, window - s)
+            q_idx = jnp.arange(s)
+            vis = ((slots_axis[None, None, :] < true_len[:, None, None])
+                   | ((slots_axis[None, None, :] >= bucket)
+                      & (slots_axis[None, None, :]
+                         <= (slots0[:, None]
+                             + q_idx[None, :])[:, :, None])))
+            logits, caches = _verify_step_rows(
+                params, xs, true_len + t_row, slots0, caches, vis,
+                module, cache_dtype)
+
+            # -- accept --
+            if sampling:
+                ft = filter_logits(logits / temperature, top_k, top_p)
+                pt = jax.nn.softmax(ft, axis=-1)                # (B,S,V)
+                qd = jnp.stack(d_dists, axis=1)                 # (B,k,V)
+                pt_d = jnp.take_along_axis(
+                    pt[:, :k_spec], d[..., None], axis=2)[..., 0]
+                qd_d = jnp.take_along_axis(
+                    qd, d[..., None], axis=2)[..., 0]
+                coin_keys = jax.vmap(lambda rk: jax.random.fold_in(
+                    rk, _SPEC_COIN_STREAM + round_idx))(row_keys)
+                u = jax.vmap(lambda kk: jax.random.uniform(
+                    kk, (k_spec,)))(coin_keys)
+                accept = u * jnp.maximum(qd_d, 1e-30) < pt_d    # (B, k)
+                n_acc = jnp.cumprod(accept.astype(jnp.int32),
+                                    axis=1).sum(axis=1)
+                # residual at every position; position k's draft dist is
+                # empty, so its residual is the target dist itself — the
+                # all-accepted bonus draw falls out of the same formula
+                qd_ext = jnp.concatenate(
+                    [qd, jnp.zeros((b, 1, qd.shape[-1]), qd.dtype)],
+                    axis=1)
+                res = jnp.maximum(pt - qd_ext, 0.0)
+                mass = res.sum(axis=-1, keepdims=True)
+                res = jnp.where(mass > 1e-30, res, pt)  # p == q guard
+                fkeys = jax.vmap(lambda rk: jax.vmap(
+                    lambda jj: jax.random.fold_in(
+                        jax.random.fold_in(
+                            rk, _SPEC_FIX_STREAM + round_idx), jj))(
+                    jnp.arange(s)))(row_keys)
+                fix = jax.vmap(jax.vmap(
+                    lambda kk, rr: jax.random.categorical(
+                        kk, jnp.where(rr > 0,
+                                      jnp.log(jnp.maximum(rr, 1e-38)),
+                                      NEG_INF))))(fkeys, res)
+                corr = jnp.take_along_axis(
+                    fix.astype(jnp.int32), n_acc[:, None], axis=1)[:, 0]
+            else:
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                agree = (d == g[:, :k_spec])
+                n_acc = jnp.cumprod(agree.astype(jnp.int32),
+                                    axis=1).sum(axis=1)
+                corr = jnp.take_along_axis(
+                    g, n_acc[:, None], axis=1)[:, 0]
+
+            # -- commit: positions 0..n are real (accepted prefix plus
+            # the correction/bonus token); stop/budget freezes evolve
+            # exactly as the per-step scan's --
+            i_idx = jnp.arange(s)[None, :]
+            d_pad = jnp.concatenate(
+                [d, jnp.zeros((b, 1), jnp.int32)], axis=1)
+            seq0 = jnp.where(i_idx < n_acc[:, None], d_pad,
+                             corr[:, None])
+            entry_done = done
+            out_toks = []
+            cur = tok
+            count = jnp.zeros(b, jnp.int32)
+            for i in range(s):
+                live_pos = (~done) & (i <= n_acc)
+                cur = jnp.where(live_pos, seq0[:, i], cur)
+                idx = t_row + 1 + i          # global token index (B,)
+                done = (done
+                        | (live_pos & stop_gate(cur, idx + 1))
+                        | (live_pos & (idx >= budget)))
+                count = count + live_pos.astype(jnp.int32)
+                out_toks.append(cur)
+            toks_out = jnp.stack(out_toks, axis=1)              # (B, S)
+            accepted = jnp.where(entry_done, 0, n_acc).astype(jnp.int32)
+            return (caches, draft_caches, toks_out, count, cur, done,
+                    accepted)
 
         # jit the meshed wrappers, not the impls: tracing runs the body,
         # so use_mesh(mesh) bakes the KV hints into every compiled
@@ -898,16 +1307,50 @@ class DecodeEngine:
             with use_mesh(mesh):
                 return serve_segment_impl(seg_len, window, *args)
 
+        def prefill_chunk0_meshed(w0, variables, tokens, true_len):
+            with use_mesh(mesh):
+                return prefill_chunk0_impl(w0, variables, tokens,
+                                           true_len)
+
+        def prefill_chunk_meshed(*args):
+            with use_mesh(mesh):
+                return prefill_chunk_impl(*args)
+
+        def prefill_finish_meshed(*args):
+            with use_mesh(mesh):
+                return prefill_finish_impl(*args)
+
         self._prefill = jax.jit(prefill_meshed)
         self._segment = jax.jit(segment_meshed, static_argnums=(0, 1))
         self._serve_segment = jax.jit(serve_segment_meshed,
                                       static_argnums=(0, 1))
+        self._prefill_chunk0 = jax.jit(prefill_chunk0_meshed,
+                                       static_argnums=(0,))
+        self._prefill_chunk = jax.jit(prefill_chunk_meshed)
+        self._prefill_finish = jax.jit(prefill_finish_meshed)
+        if spec_tokens:
+            def draft_prefill_meshed(draft_variables, prompts):
+                with use_mesh(mesh):
+                    return draft_prefill_impl(draft_variables, prompts)
+
+            def spec_round_meshed(window, *args):
+                with use_mesh(mesh):
+                    return spec_round_impl(window, *args)
+
+            self._draft_prefill = jax.jit(draft_prefill_meshed)
+            self._spec_round = jax.jit(spec_round_meshed,
+                                       static_argnums=(0,))
         self._programs: set = set()
         self._program_costs: dict = {}  # program key -> captured cost row
         # (captured once at the recompile; replayed into every later
         # run_telemetry block so warm-engine runs still get roofline rows)
         self.last_segments_run = 0
         self.last_new_tokens_computed = 0
+        self.last_exit_checks_skipped = 0
+        self.last_spec_rounds = 0
+        self.last_spec_drafted = 0
+        self.last_spec_accepted = 0
+        self.last_spec_acceptance = 0.0
 
     def bucket_for(self, prompt_len: int) -> int:
         return bucket_length(prompt_len, self.module.max_len,
@@ -966,6 +1409,88 @@ class DecodeEngine:
         need = min(bucket + max_t + seg_len, self.module.max_len)
         return _round_up(max(need, bucket + 1), self.chunk)
 
+    def serve_prefill_chunks(self, bucket: int) -> int:
+        """How many chunks a chunked prefill of this bucket runs (0 = the
+        whole-prompt program applies: chunking off, bucket no larger than
+        the chunk, or a bucket the chunk doesn't divide — buckets are
+        powers of two, so any power-of-two `prefill_chunk` divides every
+        bucket it's smaller than)."""
+        cl = self.prefill_chunk
+        if not cl or bucket <= cl or bucket % cl:
+            return 0
+        return bucket // cl
+
+    def serve_prefill_chunk(self, variables, prompts, true_len,
+                            index: int, state):
+        """Run chunk `index` of a join cohort's chunked prefill; `state`
+        is None for chunk 0, else the (caches, last_logits) carry the
+        previous chunk returned.  The serving engine interleaves these
+        calls with resident decode segments, so a long prompt never
+        stalls running requests (serve/engine.py)."""
+        prompts = np.asarray(prompts)
+        b, p = prompts.shape
+        cl = self.prefill_chunk
+        w0 = _round_up(p + 1, self.chunk)
+        tl = jnp.asarray(true_len)
+        tokens = jnp.asarray(prompts[:, index * cl:(index + 1) * cl])
+        if index == 0:
+            state = self._prefill_chunk0(w0, variables, tokens, tl)
+            self._program("prefill_chunk0", b, cl, w0)
+        else:
+            caches, last = state
+            state = self._prefill_chunk(variables, tokens, caches, last,
+                                        tl, jnp.asarray(index * cl,
+                                                        jnp.int32))
+            self._program("prefill_chunk", b, cl, w0)
+        return state
+
+    def serve_prefill_finish(self, state, live, row_keys):
+        """Close a chunked serve prefill: the same (tok, done, caches)
+        contract as `serve_prefill`, ready for `merge_cache_rows`."""
+        caches, last = state
+        b = int(last.shape[0])
+        w0 = int(caches[0][0].shape[1])
+        tok, done, caches = self._prefill_finish(caches, last,
+                                                 jnp.asarray(live),
+                                                 row_keys)
+        self._program("prefill_finish", b, w0)
+        return tok, done, caches
+
+    def serve_draft_prefill(self, draft_variables, prompts):
+        """Prefill the draft model's cache for a join cohort (speculative
+        serving): returns the draft caches to splice alongside the target
+        caches (`merge_cache_rows` handles both)."""
+        prompts = np.asarray(prompts)
+        b, p = prompts.shape
+        caches = self._draft_prefill(draft_variables,
+                                     jnp.asarray(prompts))
+        self._program("draft_prefill", b, p)
+        return caches
+
+    def serve_spec_round(self, variables, draft_variables, caches,
+                         draft_caches, tok, done, true_len, budget,
+                         bucket: int, t_row, round_idx: int, row_keys,
+                         window: int):
+        """One speculative round over the resident batch — the SAME
+        compiled program as the batch path (per-row step offsets and
+        budgets from the start).  Returns (caches, draft_caches, toks
+        (B, k+1), counts, tok, done, accepted); the engine advances each
+        row's t_row by its count and emits the counted prefix."""
+        b = int(tok.shape[0])
+        w_in = int(caches[0][0].shape[1])
+        window = max(int(window), w_in,
+                     int(draft_caches[0][0].shape[1]))
+        key = ("spec_round", b, w_in, window, self.spec_tokens)
+        out = self._spec_round(
+            window, variables, draft_variables, caches, draft_caches,
+            tok, done, jnp.asarray(true_len),
+            jnp.asarray(budget, jnp.int32),
+            jnp.asarray(bucket, jnp.int32),
+            jnp.asarray(t_row, jnp.int32),
+            jnp.asarray(round_idx, jnp.int32), row_keys)
+        self._program(*key)
+        return out
+
     @staticmethod
     def merge_cache_rows(dst_caches, src_caches, dst_rows, src_rows,
                          mesh=None):
@@ -999,8 +1524,42 @@ class DecodeEngine:
             trace_event("recompile", cat="compile", where="decode",
                         program=str(key))
 
+    def _run_chunked_prefill(self, variables, prompts, true_len, live,
+                             row_keys):
+        """Host loop for a CHUNKED prefill: chunk 0 allocates, later
+        chunks share one compiled program (traced offset), finish
+        samples and (int8) quantizes.  Same (tok, done, caches) contract
+        — and the same first token — as the whole-prompt program."""
+        prompts = jnp.asarray(prompts)
+        b, p = int(prompts.shape[0]), int(prompts.shape[1])
+        cl = self.prefill_chunk
+        w0 = _round_up(p + 1, self.chunk)
+        tl = jnp.asarray(true_len)
+        with trace_span("decode.prefill_chunk", cat="bucket", bucket=p,
+                        batch=b, chunk=cl, index=0):
+            state = self._prefill_chunk0(w0, variables, prompts[:, :cl],
+                                         tl)
+        self._program("prefill_chunk0", b, cl, w0)
+        for ci in range(1, p // cl):
+            caches, last = state
+            with trace_span("decode.prefill_chunk", cat="bucket",
+                            bucket=p, batch=b, chunk=cl, index=ci):
+                state = self._prefill_chunk(
+                    variables, prompts[:, ci * cl:(ci + 1) * cl],
+                    caches, last, tl, jnp.asarray(ci * cl, jnp.int32))
+            self._program("prefill_chunk", b, cl, w0)
+        caches, last = state
+        tok, done, caches = self._prefill_finish(
+            caches, last, jnp.asarray(live), row_keys)
+        self._program("prefill_finish", b, w0)
+        return tok, done, caches
+
+    def _chunks_prefill(self, bucket: int) -> bool:
+        return self.serve_prefill_chunks(bucket) > 0
+
     def generate(self, variables, prompts, true_len, *, rng=None,
-                 row_ids=None, live=None) -> np.ndarray:
+                 row_ids=None, live=None,
+                 draft_variables=None) -> np.ndarray:
         """Generate `max_new_tokens` per row: prompts (B, bucket) int32
         right-padded, true_len (B,) per-row prompt lengths.  Returns the
         GENERATED region (B, max_new_tokens) — after a row's first stop
@@ -1011,6 +1570,12 @@ class DecodeEngine:
         0..B-1); `live=False` rows (mesh shard padding) are born done so
         they never hold the batch open.  Arrays may be host numpy or
         already-placed device arrays (the mesh path shards them first).
+        With `spec_tokens` set, `draft_variables` is required and decode
+        runs draft/verify rounds instead of per-token segments — greedy
+        outputs are byte-identical to the non-speculative path
+        (test-pinned); sampled outputs draw from the same target
+        distribution through rejection sampling, on disjoint RNG
+        streams.
         """
         b, p = np.shape(prompts)[0], np.shape(prompts)[1]
         tl_host = np.asarray(true_len)
@@ -1030,27 +1595,47 @@ class DecodeEngine:
             live = np.ones(b, bool)
         timings = active_timings()
         run = active_run()
+        if self.spec_tokens:
+            if draft_variables is None:
+                raise ValueError(
+                    "this engine speculates (spec_tokens "
+                    f"{self.spec_tokens}); generate() needs "
+                    "draft_variables")
+            return self._generate_speculative(
+                variables, draft_variables, prompts, true_len, live,
+                row_keys, b, p, timings, run)
         with trace_span("decode.generate", cat="phase", bucket=p, batch=b,
                         max_new_tokens=self.max_new_tokens):
             pf_key = ("prefill", b, p)
             pf_args = (variables, jnp.asarray(prompts),
                        jnp.asarray(true_len), jnp.asarray(live), row_keys)
-            if run is not None and pf_key not in self._programs:
-                # compile-time cost capture (observe/costmodel.py): once
-                # per program, with a synced probe execution — the live
-                # span below walls only the async dispatch
-                rec = capture_program_cost(self._prefill, pf_args,
-                                           where="decode", program=pf_key,
-                                           run=run, probe=True)
-                if rec is not None:
-                    self._program_costs[pf_key] = rec
-            with span_on(timings, "prefill"), \
-                    trace_span("decode.prefill", cat="bucket", bucket=p,
-                               batch=b) as psp:
-                tok, done, caches = self._prefill(*pf_args)
-                if timings is not None:
-                    jax.block_until_ready(tok)
-            self._program(*pf_key)
+            if self._chunks_prefill(p):
+                with span_on(timings, "prefill"), \
+                        trace_span("decode.prefill", cat="bucket",
+                                   bucket=p, batch=b, chunked=True):
+                    tok, done, caches = self._run_chunked_prefill(
+                        variables, prompts, true_len, live, row_keys)
+                    if timings is not None:
+                        jax.block_until_ready(tok)
+                psp = None
+            else:
+                if run is not None and pf_key not in self._programs:
+                    # compile-time cost capture (observe/costmodel.py):
+                    # once per program, with a synced probe execution —
+                    # the live span below walls only the async dispatch
+                    rec = capture_program_cost(self._prefill, pf_args,
+                                               where="decode",
+                                               program=pf_key,
+                                               run=run, probe=True)
+                    if rec is not None:
+                        self._program_costs[pf_key] = rec
+                with span_on(timings, "prefill"), \
+                        trace_span("decode.prefill", cat="bucket",
+                                   bucket=p, batch=b) as psp:
+                    tok, done, caches = self._prefill(*pf_args)
+                    if timings is not None:
+                        jax.block_until_ready(tok)
+                self._program(*pf_key)
             if run is not None and psp is not None:
                 # replay the remembered cost row so warm-engine runs (no
                 # recompile) still get roofline rows (idempotent)
@@ -1064,9 +1649,16 @@ class DecodeEngine:
             prev_w = _round_up(p + 1, self.chunk)
             parts = []
             segments_run = 0
+            exit_checks_skipped = 0
             with span_on(timings, "decode"):
                 for t0, seg_len, window in segs:
-                    if check_exit and bool(
+                    if check_exit and t0 + 1 < self.min_new_tokens:
+                        # tokens 0..t0 exist, and a stop only freezes
+                        # from token index min_new_tokens-1 on — no row
+                        # can possibly be done, so skip the device->host
+                        # sync outright (counted; gauge below)
+                        exit_checks_skipped += 1
+                    elif check_exit and bool(
                             np.asarray(jax.device_get(done)).all()):
                         trace_event("decode.early_exit", cat="decode",
                                     at_step=t0, batch=b,
@@ -1114,8 +1706,11 @@ class DecodeEngine:
                     + [np.asarray(tok)[:, None]], axis=1)
         if run is not None:
             run.gauge("decode.compiled_programs", self.compiled_programs)
+            run.gauge("decode.early_exit_checks_skipped",
+                      exit_checks_skipped)
         self.last_segments_run = segments_run
         self.last_new_tokens_computed = generated.shape[1]
+        self.last_exit_checks_skipped = exit_checks_skipped
         if generated.shape[1] < self.max_new_tokens:
             # early exit: every row is frozen on its stop token — the fill
             # is exactly what the skipped segments would have emitted
@@ -1123,6 +1718,104 @@ class DecodeEngine:
                              self.max_new_tokens - generated.shape[1], axis=1)
             generated = np.concatenate([generated, fill], axis=1)
         return generated.astype(np.int32)
+
+    def _generate_speculative(self, variables, draft_variables, prompts,
+                              true_len, live, row_keys, b, p, timings,
+                              run) -> np.ndarray:
+        """The speculative form of `generate`: target prefill (chunked or
+        whole — the same programs), a draft prefill, then draft/verify
+        rounds until every row freezes or fills its budget.  One round
+        program serves every round; the cache window grows with the
+        oldest row exactly as the serve path's does."""
+        k = self.spec_tokens
+        max_new = self.max_new_tokens
+        with trace_span("decode.generate", cat="phase", bucket=p,
+                        batch=b, max_new_tokens=max_new, spec_tokens=k):
+            with span_on(timings, "prefill"), \
+                    trace_span("decode.prefill", cat="bucket", bucket=p,
+                               batch=b, speculative=True):
+                if self._chunks_prefill(p):
+                    tok, done, caches = self._run_chunked_prefill(
+                        variables, prompts, true_len, live, row_keys)
+                else:
+                    tok, done, caches = self._prefill(
+                        variables, jnp.asarray(prompts),
+                        jnp.asarray(true_len), jnp.asarray(live),
+                        row_keys)
+                    self._program("prefill", b, p)
+                dcaches = self._draft_prefill(draft_variables,
+                                              jnp.asarray(prompts))
+                self._program("draft_prefill", b, p)
+                if timings is not None:
+                    jax.block_until_ready(tok)
+            out = np.zeros((b, max_new), np.int32)
+            out[:, 0] = np.asarray(tok)
+            emitted = np.ones(b, np.int64)
+            t_row_h = np.zeros(b, np.int32)
+            # freeze once a row's newest token index reaches max_new-1 —
+            # the per-step scan's budget semantics (serve_segment_impl)
+            budget = jnp.full(b, max_new - 1, jnp.int32)
+            tl_dev = jnp.asarray(true_len)
+            bucket_dev = jnp.asarray(p, jnp.int32)
+            done_h = np.asarray(jax.device_get(done))
+            drafted = 0
+            accepted_total = 0
+            rounds = 0
+            with span_on(timings, "decode"):
+                while not bool(done_h.all()):
+                    w_in = int(caches[0][0].shape[1])
+                    window = max(
+                        self.serve_window(p, int(t_row_h.max()), k + 1),
+                        w_in, int(dcaches[0][0].shape[1]))
+                    key = ("spec_round", b, w_in, window, k)
+                    with trace_span("decode.spec_round", cat="segment",
+                                    window=window, round=rounds):
+                        (caches, dcaches, toks, counts, tok, done,
+                         acc) = self._spec_round(
+                            window, variables, draft_variables, caches,
+                            dcaches, tok, done, tl_dev, budget,
+                            bucket_dev, jnp.asarray(t_row_h),
+                            jnp.asarray(rounds, jnp.int32), row_keys)
+                    self._program(*key)
+                    toks_h = np.asarray(toks)
+                    counts_h = np.asarray(counts)
+                    live_rows = counts_h > 0
+                    drafted += int(live_rows.sum()) * k
+                    accepted_total += int(np.asarray(acc).sum())
+                    for r in np.nonzero(live_rows)[0]:
+                        take = min(int(counts_h[r]),
+                                   max_new - int(emitted[r]))
+                        if take > 0:
+                            out[r, emitted[r]:emitted[r] + take] = \
+                                toks_h[r, :take]
+                            emitted[r] += take
+                    t_row_h = t_row_h + counts_h.astype(np.int32)
+                    done_h = np.asarray(done)
+                    rounds += 1
+            tok_h = np.asarray(tok)
+            for r in range(b):
+                # rows frozen early repeat their stop token, exactly as
+                # the non-speculative fill does
+                if emitted[r] < max_new:
+                    out[r, int(emitted[r]):] = tok_h[r]
+        rate = accepted_total / drafted if drafted else 0.0
+        self.last_spec_rounds = rounds
+        self.last_spec_drafted = drafted
+        self.last_spec_accepted = accepted_total
+        self.last_spec_acceptance = rate
+        self.last_segments_run = rounds
+        self.last_new_tokens_computed = int(emitted.max()) if b else 0
+        # process counters surface on /metrics as _total series even with
+        # no run active; the gauges ride run_summary AND the Prometheus
+        # exposition (observe/export.py renders live-run gauges)
+        from mmlspark_tpu.observe.metrics import inc_counter
+        inc_counter("decode.spec_drafted_tokens", drafted)
+        inc_counter("decode.spec_accepted_tokens", accepted_total)
+        if run is not None:
+            run.gauge("decode.compiled_programs", self.compiled_programs)
+            run.gauge("decode.spec_acceptance_rate", round(rate, 4))
+            run.gauge("decode.spec_rounds", rounds)
+        return out
 
 
 class TextGenerator(Transformer):
@@ -1195,17 +1888,52 @@ class TextGenerator(Transformer):
                          "the module's own dtype.  Beam search ignores "
                          "this (full-cache model-dtype path)", ptype=str,
                          domain=("model", "int8"))
+    minNewTokens = Param(1, "suppress stop tokens until a row has "
+                         "generated this many tokens (including the "
+                         "stop itself).  Until the floor is reachable "
+                         "the engine also skips the between-segment "
+                         "device->host early-exit syncs entirely "
+                         "(decode.early_exit_checks_skipped gauge)",
+                         ptype=int, validator=lambda v: v >= 1)
+    specTokens = Param(0, "speculative decoding: tokens the draft model "
+                       "proposes per verify round (0 = off; requires "
+                       "set_draft_bundle).  Greedy outputs stay "
+                       "byte-identical to non-speculative decoding; "
+                       "sampled outputs draw from the same target "
+                       "distribution via rejection sampling (different "
+                       "RNG streams).  Acceptance rate lands on the "
+                       "decode.spec_acceptance_rate gauge", ptype=int,
+                       validator=lambda v: v >= 0)
+    prefillChunk = Param(0, "chunked prefill: run prompt forwards in "
+                         "chunks of this many tokens (0 = whole-prompt)."
+                         "  Primarily a serving knob — serve/engine.py "
+                         "interleaves chunks with resident decode "
+                         "segments so long prompts don't stall running "
+                         "requests; the batch path runs the same "
+                         "programs", ptype=int,
+                         validator=lambda v: v >= 0)
 
     def __init__(self, bundle: Optional["ModelBundle"] = None, **kwargs):
         super().__init__(**kwargs)
         self._bundle = bundle
+        self._draft_bundle = None
         self._compiled: dict = {}
         self._mesh = None
         self._device_vars: dict = {}   # per-mesh replicated weights
+        self._draft_device_vars: dict = {}
 
     def set_bundle(self, bundle: "ModelBundle") -> "TextGenerator":
         self._bundle = bundle
         self._compiled.clear()
+        return self
+
+    def set_draft_bundle(self, bundle) -> "TextGenerator":
+        """The small LM `specTokens` speculation drafts with
+        (zoo/speculative.py builds one from a target bundle).  Not
+        persisted by save(): re-attach after load, exactly like a mesh."""
+        self._draft_bundle = bundle
+        self._compiled.clear()
+        self._draft_device_vars = {}
         return self
 
     def set_mesh(self, mesh) -> "TextGenerator":
@@ -1248,14 +1976,25 @@ class TextGenerator(Transformer):
         top_p = self.topP if sampling and self.topP < 1.0 else None
         stops = tuple(int(t) for t in (self.stopTokens or ()))
         kv_dtype = self.kvCacheDtype or "model"
+        spec = int(self.specTokens)
+        if spec and self._draft_bundle is None:
+            raise ValueError(
+                "specTokens > 0 needs a draft model; call "
+                "set_draft_bundle() (zoo/speculative.py builds one)")
         key = ("engine", self.maxNewTokens, self.temperature, top_k, top_p,
-               stops, self.cacheChunk, kv_dtype)
+               stops, self.cacheChunk, kv_dtype, self.minNewTokens,
+               self.prefillChunk or None, spec)
         if key not in self._compiled:
             self._compiled[key] = DecodeEngine(
                 self._bundle.module(), self.maxNewTokens,
                 temperature=self.temperature, top_k=top_k, top_p=top_p,
                 stop_tokens=stops, chunk=self.cacheChunk,
-                cache_dtype=kv_dtype, mesh=self._mesh)
+                cache_dtype=kv_dtype, mesh=self._mesh,
+                min_new_tokens=self.minNewTokens,
+                prefill_chunk=self.prefillChunk or None,
+                draft_module=(self._draft_bundle.module() if spec
+                              else None),
+                spec_tokens=spec)
         return self._compiled[key]
 
     def _device_variables(self):
@@ -1278,6 +2017,17 @@ class TextGenerator(Transformer):
                 self._device_vars[self._mesh] = replicate_tree(
                     self._bundle.variables, self._mesh)
         return self._device_vars[self._mesh]
+
+    def _draft_device_variables(self):
+        """Draft weights always replicate (the draft is small by design;
+        its cache rides the data axis only — DRAFT_KV_CACHE_SPEC)."""
+        if self._mesh is None:
+            return self._draft_bundle.variables
+        if self._mesh not in self._draft_device_vars:
+            from mmlspark_tpu.parallel.bridge import replicate_tree
+            self._draft_device_vars[self._mesh] = replicate_tree(
+                self._draft_bundle.variables, self._mesh)
+        return self._draft_device_vars[self._mesh]
 
     def _transform_beam(self, rows: list, out: list) -> None:
         """Beam rows decode through the full-cache per-length programs."""
@@ -1339,14 +2089,20 @@ class TextGenerator(Transformer):
                         [row_ids, n + np.arange(pad, dtype=np.int32)])
                 prompts, true_len, live = put_batch_parts(
                     self._mesh, prompts, true_len, live)
+            draft_vars = (self._draft_device_variables()
+                          if engine.spec_tokens else None)
             got = engine.generate(variables, prompts, true_len, rng=base,
-                                  row_ids=row_ids, live=live)
+                                  row_ids=row_ids, live=live,
+                                  draft_variables=draft_vars)
             for j, i in enumerate(idxs):
                 gen = got[j]
                 if stops.size:
-                    hits = np.isin(gen, stops).nonzero()[0]
+                    # stops before the minNewTokens floor were suppressed
+                    # by the engine; don't trim at them either
+                    start = max(int(self.minNewTokens) - 1, 0)
+                    hits = np.isin(gen[start:], stops).nonzero()[0]
                     if hits.size:
-                        gen = gen[:hits[0] + 1]
+                        gen = gen[:start + hits[0] + 1]
                 out[i] = np.concatenate([rows[i], gen])
 
     def transform(self, table: "DataTable") -> "DataTable":
@@ -1382,6 +2138,8 @@ class TextGenerator(Transformer):
         self._compiled = {}
         self._mesh = None
         self._device_vars = {}
+        self._draft_bundle = None
+        self._draft_device_vars = {}
 
 
 def naive_generate(module, variables, prompts, max_new_tokens: int) -> np.ndarray:

@@ -103,6 +103,49 @@ def single_query_attention(q: jax.Array, k_cache: jax.Array,
     return jnp.einsum("bhl,blhd->bhd", w, v_cache.astype(jnp.float32))
 
 
+def segment_cache_attention(q: jax.Array, k_cache: jax.Array,
+                            v_cache: jax.Array, visible: jax.Array,
+                            scale: Optional[float] = None,
+                            k_scale: Optional[jax.Array] = None,
+                            v_scale: Optional[jax.Array] = None
+                            ) -> jax.Array:
+    """A short token segment's queries against a KV-cache window — the
+    multi-query generalization of `single_query_attention`, and the read
+    the speculative-decoding verify forward runs (models/generate.py): the
+    target model scores all k+1 drafted positions in ONE forward, so each
+    query needs its own visibility row.
+
+    q: (B, S, H, D) — S segment queries per row (S is small: the
+        speculative draft length plus one).
+    k_cache, v_cache: (B, L, H, D) — a prefix window of the cache, already
+        containing the segment's own K/V (the caller writes before
+        reading, exactly as the single-query step does).
+    visible: (B, S, L) bool — per-QUERY visibility: query j of a row sees
+        its true-prompt slots plus the decode slots up to and including
+        its own write slot, so later drafted positions attend earlier ones
+        but never themselves-plus-one.
+    k_scale, v_scale: (B, L, H) float32 or None — int8-cache dequant
+        scales, hoisted exactly as in `single_query_attention`: K's scale
+        multiplies the score rows AFTER QK^T, V's folds into the softmax
+        weights BEFORE PV, so both einsums stream raw int8 bytes.
+
+    Float32 statistics throughout; returns (B, S, H, D) float32.  With
+    S = 1 this is elementwise-identical math to `single_query_attention`
+    (same contractions, same masking) — the property the speculative
+    path's greedy byte-exactness rests on (test-pinned)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bshd,blhd->bhsl", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    if k_scale is not None:
+        s = s * k_scale.astype(jnp.float32).transpose(0, 2, 1)[:, :, None, :]
+    s = jnp.where(visible[:, None], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        w = w * v_scale.astype(jnp.float32).transpose(0, 2, 1)[:, :, None, :]
+    return jnp.einsum("bhsl,blhd->bshd", w, v_cache.astype(jnp.float32))
+
+
 def _block_scores(q, k, scale):
     return jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
 

@@ -78,6 +78,15 @@ HIDDEN_SPEC = P(DATA_AXIS, None, MODEL_AXIS)
 KV_CACHE_SPEC = P(DATA_AXIS, None, MODEL_AXIS, None)
 KV_SCALE_SPEC = P(DATA_AXIS, None, MODEL_AXIS)
 
+# Speculative decoding (models/generate.py): the DRAFT model's cache rides
+# the data axis only — a draft sized for low latency rarely has a head
+# count the mesh's model axis divides, and its whole forward is a
+# rounding error next to the target's, so replicating its heads costs
+# nothing while keeping the verify program (which runs the TARGET layout
+# above) free to shard.  Draft params replicate for the same reason.
+DRAFT_KV_CACHE_SPEC = P(DATA_AXIS, None, None, None)
+DRAFT_KV_SCALE_SPEC = P(DATA_AXIS, None, None)
+
 
 def path_str(path: Sequence) -> str:
     """'/'-joined form of a jax tree_map_with_path key path — the string

@@ -43,8 +43,13 @@ seeing plain float kernels.
 
 KV-cache quantization (`quantize_kv`) is the activation-side counterpart:
 per-head symmetric int8, quantize-on-write inside the decode step, dequant
-inside `ops/attention.single_query_attention` — models/generate.py wires
-it behind `TextGenerator.kvCacheDtype`.
+on read — on a single TPU device inside the fused Pallas kernel
+(`ops/decode_attention.fused_single_query_attention`: k_scale applied
+after QK^T, v_scale folded into the softmax weights, so the cache
+streams as 1 byte/element with no dequantized copy ever materialized),
+elsewhere inside the reference `ops/attention.single_query_attention`
+with the identical algebraic hoist — models/generate.py wires it behind
+`TextGenerator.kvCacheDtype`.
 """
 
 from __future__ import annotations

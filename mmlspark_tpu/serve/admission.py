@@ -69,7 +69,15 @@ class StepTimeEstimator:
     `service_s(bucket, n_tokens)` answers "how long would this request
     occupy the engine end to end" and returns None until a measurement
     for the bucket (or any bucket, as a coarse fallback) exists — the
-    admission controller treats None as 'no proof, admit'."""
+    admission controller treats None as 'no proof, admit'.
+
+    The feeds keep the model honest under the engine's fast paths: a
+    CHUNKED prefill reports its summed chunk walls as one observation
+    (the full prompt cost, not one slice), and a SPECULATIVE round
+    reports round wall over tokens actually emitted per live row — so
+    feasibility proofs track the measured speculative speedup, not the
+    optimistic k+1 bound, and shrink admission back when acceptance
+    drops."""
 
     def __init__(self, alpha: float = 0.3):
         self.alpha = float(alpha)

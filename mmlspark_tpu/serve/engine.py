@@ -85,6 +85,21 @@ SERVE_WARMUP_JOINS = config.register(
     "(cohort merges and terminal segments at each grown cache width) — "
     "slower startup, but a ready engine then NEVER pays XLA against a "
     "deadline; recommended for production fleets", ptype=bool)
+SERVE_PREFILL_CHUNK = config.register(
+    "MMLSPARK_TPU_SERVE_PREFILL_CHUNK", 0,
+    "serving: chunked prefill — join cohorts prefill in chunks of this "
+    "many prompt tokens, ONE chunk per scheduler tick, so a long "
+    "prompt's forward interleaves with resident decode segments instead "
+    "of stalling them (0 = whole-prompt prefill; power of two "
+    "recommended — buckets a non-divisor chunk doesn't divide fall back "
+    "to whole-prompt)", ptype=int)
+SERVE_SPEC_TOKENS = config.register(
+    "MMLSPARK_TPU_SERVE_SPEC_TOKENS", 0,
+    "serving: speculative decoding — draft-model tokens proposed per "
+    "verify round (0 = off; needs a draft_bundle on the ServingEngine). "
+    "Greedy outputs stay byte-identical to plain decoding; a round "
+    "advances a row by up to this+1 tokens for one target forward",
+    ptype=int)
 
 
 @dataclasses.dataclass
@@ -113,6 +128,8 @@ class ServeConfig:
     breaker_reset_s: float = 5.0
     warmup_buckets: tuple = ()        # () = the engine's smallest bucket
     warmup_joins: Optional[bool] = None  # pre-compile late-join shapes too
+    prefill_chunk: Optional[int] = None  # chunked prefill (0 = off)
+    spec_tokens: Optional[int] = None    # speculative draft depth (0 = off)
 
     def __post_init__(self):
         read = lambda explicit, var, cast: cast(
@@ -128,6 +145,13 @@ class ServeConfig:
                                     SERVE_DRAIN_TIMEOUT_S, float)
         self.warmup_joins = read(self.warmup_joins,
                                  SERVE_WARMUP_JOINS, bool)
+        self.prefill_chunk = read(self.prefill_chunk,
+                                  SERVE_PREFILL_CHUNK, int)
+        self.spec_tokens = read(self.spec_tokens, SERVE_SPEC_TOKENS, int)
+        if self.prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0")
+        if self.spec_tokens < 0:
+            raise ValueError("spec_tokens must be >= 0")
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if self.segment_steps < 1:
@@ -147,6 +171,10 @@ class _Group:
         self.capacity = capacity
         self.rows: list[Optional[Request]] = [None] * capacity
         self.caches = None
+        self.draft_caches = None       # speculative lanes only
+        self.spec_rounds = 0           # per-group RNG round counter
+        self.reserved: set = set()     # slots held by in-flight chunked
+        # prefills (their rows stay None until the cohort splices in)
         self.tok = np.zeros(capacity, np.int32)
         self.done = np.ones(capacity, bool)
         self.true_len = np.ones(capacity, np.int32)
@@ -160,7 +188,8 @@ class _Group:
         self.keys_ids: Optional[tuple] = None
 
     def free_slots(self) -> list:
-        return [i for i, r in enumerate(self.rows) if r is None]
+        return [i for i, r in enumerate(self.rows)
+                if r is None and i not in self.reserved]
 
     def live_slots(self) -> list:
         return [i for i, r in enumerate(self.rows) if r is not None]
@@ -188,8 +217,8 @@ class ServingEngine:
     """
 
     def __init__(self, bundle, cfg: Optional[ServeConfig] = None, *,
-                 degraded_bundle=None, clock: Optional[Clock] = None,
-                 mesh=None):
+                 degraded_bundle=None, draft_bundle=None,
+                 clock: Optional[Clock] = None, mesh=None):
         self.cfg = cfg or ServeConfig()
         self._clock = clock
         self._bundle = bundle
@@ -198,6 +227,17 @@ class ServingEngine:
         # at mp=1, partition-rule sharded when the mesh has a model axis)
         # and every DecodeEngine program traces its KV hints against it
         self._mesh = mesh
+        # speculative lanes: one shared draft (zoo/speculative.py) drafts
+        # for every lane — greedy exactness is per-lane by construction,
+        # so the quantized degraded lane pairs with the same draft
+        if self.cfg.spec_tokens and draft_bundle is None:
+            raise ValueError(
+                "spec_tokens > 0 needs a draft_bundle "
+                "(zoo.truncated_draft_bundle builds one)")
+        self._draft_module = (draft_bundle.module()
+                              if self.cfg.spec_tokens else None)
+        self._draft_vars = (self._place_replicated(draft_bundle)
+                            if self.cfg.spec_tokens else None)
         self._engines = {"primary": self._decode_engine(self._module)}
         self._variables = {"primary": self._place_variables(bundle)}
         if degraded_bundle is not None:
@@ -219,6 +259,9 @@ class ServingEngine:
             max_batch=self.cfg.max_batch,
             degraded_available=degraded_bundle is not None, clock=clock)
         self._groups: dict[tuple, _Group] = {}
+        # in-flight chunked prefills: one advances a single chunk per
+        # tick, between phase 4 (joins) and phase 5 (segments)
+        self._pending: list[dict] = []
         self._state = CREATED
         self._state_lock = threading.Lock()
         self._wake = threading.Condition()
@@ -246,7 +289,19 @@ class ServingEngine:
             module, self.cfg.max_new_tokens,
             temperature=self.cfg.temperature, top_k=self.cfg.top_k,
             top_p=self.cfg.top_p, stop_tokens=self.cfg.stop_tokens,
-            chunk=self.cfg.cache_chunk, mesh=self._mesh)
+            chunk=self.cfg.cache_chunk, mesh=self._mesh,
+            prefill_chunk=self.cfg.prefill_chunk or None,
+            draft_module=self._draft_module,
+            spec_tokens=self.cfg.spec_tokens)
+
+    def _place_replicated(self, bundle):
+        """Draft weights replicate on any mesh (the draft is small; its
+        cache rides the data axis only — parallel/partition.py
+        DRAFT_KV_CACHE_SPEC)."""
+        if self._mesh is None:
+            return bundle.variables
+        from mmlspark_tpu.parallel.bridge import replicate_tree
+        return replicate_tree(bundle.variables, self._mesh)
 
     def _place_variables(self, bundle):
         """One-time weight placement for a lane: host tree off-mesh,
@@ -319,6 +374,7 @@ class ServingEngine:
         cap = self.cfg.max_batch
         seg = self.cfg.segment_steps
         cohorts = {}
+        chunks = eng.serve_prefill_chunks(bucket)
         n = 1
         while True:
             m = min(n, cap)
@@ -326,8 +382,20 @@ class ServingEngine:
             live = np.ones(m, bool)
             tl = np.ones(m, np.int32)
             keys = self._row_keys(np.arange(m))
-            tok, done, caches = eng.serve_prefill(variables, prompts, tl,
-                                                  live, keys)
+            if chunks:
+                # the chunked programs are what this bucket runs live
+                state = None
+                for ci in range(chunks):
+                    state = eng.serve_prefill_chunk(variables, prompts,
+                                                    tl, ci, state)
+                tok, done, caches = eng.serve_prefill_finish(state, live,
+                                                             keys)
+            else:
+                tok, done, caches = eng.serve_prefill(variables, prompts,
+                                                      tl, live, keys)
+            if eng.spec_tokens:
+                dcaches = eng.serve_draft_prefill(self._draft_vars,
+                                                  prompts)
             cohorts[m] = caches
             if n >= cap:
                 break
@@ -354,6 +422,23 @@ class ServingEngine:
         t_row = np.zeros(cap, np.int32)
         t = 0
         warm_joins(caches)
+        if eng.spec_tokens:
+            # speculative lanes replace segments with draft-verify
+            # rounds: sweep the same window ladder at full-acceptance
+            # stride, then pin the steady (window -> window) class that
+            # partial acceptance revisits
+            k1 = eng.spec_tokens + 1
+            rounds = 0
+            while t < self.cfg.max_new_tokens + k1:
+                tr = np.minimum(t_row + t, self.cfg.max_new_tokens - 1)
+                window = eng.serve_window(bucket, int(tr.max()), k1)
+                (caches, dcaches, _, _, tok, done,
+                 _) = eng.serve_spec_round(
+                    variables, self._draft_vars, caches, dcaches, tok,
+                    done, tl, budget, bucket, tr, rounds, keys, window)
+                t += k1
+                rounds += 1
+            return
         while t < self.cfg.max_new_tokens:
             window = eng.serve_window(bucket, t, seg)
             caches, _, tok, done = eng.serve_step(
@@ -526,6 +611,13 @@ class ServingEngine:
                     g.release(i)
                     self._count("cancelled_external")
                     return True
+        for job in list(self._pending):
+            if req in job["reqs"]:
+                # its cohort row keeps prefilling (static shapes) but the
+                # finish-time expiry filter drops it before the splice
+                req.finish(CANCELLED, self.now(), detail)
+                self._count("cancelled_external")
+                return True
         if self.admission.remove(req):
             req.finish(CANCELLED, self.now(), detail)
             self._count("cancelled_external")
@@ -534,8 +626,11 @@ class ServingEngine:
 
     def in_flight(self) -> int:
         # list() the dict: submit threads read while the loop thread
-        # adds/drops groups (iterating the live dict would race)
-        return sum(len(g.live_slots()) for g in list(self._groups.values()))
+        # adds/drops groups (iterating the live dict would race);
+        # chunked-prefill cohorts count too — they hold reserved slots
+        return (sum(len(g.live_slots())
+                    for g in list(self._groups.values()))
+                + sum(len(job["reqs"]) for job in list(self._pending)))
 
     def in_flight_tokens(self) -> int:
         total = 0
@@ -544,6 +639,9 @@ class ServingEngine:
                 req = g.rows[i]
                 if req is not None:
                     total += max(0, req.max_new_tokens - len(req.tokens))
+        for job in list(self._pending):
+            for req in job["reqs"]:
+                total += req.max_new_tokens
         return total
 
     def _row_keys(self, ids) -> jax.Array:
@@ -606,6 +704,11 @@ class ServingEngine:
                                    "drain timeout")
                     g.release(i)
                     worked = True
+            for job in self._pending:
+                for req in job["reqs"]:
+                    self._complete(req, CANCELLED, "drain timeout")
+                    worked = True
+            self._pending.clear()
             for req in self.admission.drop_expired(float("inf")):
                 self._complete(req, CANCELLED, "drain timeout")
                 worked = True
@@ -632,22 +735,31 @@ class ServingEngine:
                 continue
             reqs = self.admission.take(bucket, len(free), lane)
             if reqs:
-                self._join(g, lane, reqs, free[:len(reqs)])
+                if self._engines[lane].serve_prefill_chunks(bucket):
+                    self._start_chunked_join(g, lane, reqs,
+                                             free[:len(reqs)])
+                else:
+                    self._join(g, lane, reqs, free[:len(reqs)])
                 worked = True
+        # 4b. advance every in-flight chunked prefill by ONE chunk — the
+        # point of chunking: the long forward yields to phase 5 between
+        # chunks instead of holding the tick for the whole prompt
+        for job in list(self._pending):
+            self._advance_prefill(job)
+            worked = True
         # 5. advance each group one segment
         for (bucket, lane), g in list(self._groups.items()):
             if g.live_slots():
                 self._advance(g, lane)
                 worked = True
-            elif not self.admission.pending():
+            elif (not g.reserved and not self.admission.pending()):
                 # empty group with no queued work: drop the cache memory
                 del self._groups[(bucket, lane)]
         return worked
 
-    def _join(self, g: _Group, lane: str, reqs: list, slots: list) -> None:
-        """Prefill a join cohort and splice it into the resident batch."""
-        eng = self._engines[lane]
-        variables = self._variables[lane]
+    def _cohort(self, g: _Group, reqs: list) -> tuple:
+        """Pack a join cohort: padded to a power of two (capped at
+        capacity) so join batches reuse a handful of compiled shapes."""
         k = len(reqs)
         n = 1
         while n < k:
@@ -662,19 +774,104 @@ class ServingEngine:
             true_len[j] = req.true_len
             live[j] = True
             ids[j] = req.id
+        return prompts, true_len, live, ids
+
+    def _join(self, g: _Group, lane: str, reqs: list, slots: list) -> None:
+        """Prefill a join cohort and splice it into the resident batch."""
+        eng = self._engines[lane]
+        variables = self._variables[lane]
+        prompts, true_len, live, ids = self._cohort(g, reqs)
         t0 = monotonic()
         with span_on_tracer(self._tracer, "serve.prefill", cat="serve",
-                            bucket=g.bucket, cohort=n, joins=k, lane=lane):
+                            bucket=g.bucket, cohort=len(ids),
+                            joins=len(reqs), lane=lane):
             tok, done, caches = eng.serve_prefill(
                 variables, prompts, true_len, live, self._row_keys(ids))
             tok_h = np.asarray(tok)
         self.estimator.observe_prefill(g.bucket, monotonic() - t0)
-        # splice cohort rows into the group
+        self._splice(g, lane, reqs, slots, list(range(len(reqs))),
+                     tok_h, caches, prompts)
+
+    def _start_chunked_join(self, g: _Group, lane: str, reqs: list,
+                            slots: list) -> None:
+        """Queue a chunked join: slots are reserved (not yet resident)
+        and `_advance_prefill` runs ONE prompt chunk per tick until the
+        cohort finishes and splices in."""
+        prompts, true_len, live, ids = self._cohort(g, reqs)
+        g.reserved.update(slots)
+        eng = self._engines[lane]
+        self._pending.append(dict(
+            group=g, lane=lane, reqs=reqs, slots=slots, prompts=prompts,
+            true_len=true_len, live=live, ids=ids, state=None, index=0,
+            chunks=eng.serve_prefill_chunks(g.bucket), elapsed=0.0))
+
+    def _advance_prefill(self, job: dict) -> None:
+        """One chunk of an in-flight chunked prefill; on the last chunk,
+        finish (sample + quantize) and splice the cohort in.  The
+        estimator's prefill EWMA sees the SUMMED chunk time — feasibility
+        math reflects the full prompt cost, not one slice of it."""
+        g: _Group = job["group"]
+        lane = job["lane"]
+        eng = self._engines[lane]
+        variables = self._variables[lane]
+        t0 = monotonic()
+        with span_on_tracer(self._tracer, "serve.prefill_chunk",
+                            cat="serve", bucket=g.bucket, lane=lane,
+                            index=job["index"], chunks=job["chunks"]):
+            job["state"] = eng.serve_prefill_chunk(
+                variables, job["prompts"], job["true_len"], job["index"],
+                job["state"])
+        job["elapsed"] += monotonic() - t0
+        self._record_serve({"event": "prefill_chunk", "bucket": g.bucket,
+                            "lane": lane, "index": job["index"],
+                            "chunks": job["chunks"],
+                            "requests": [r.id for r in job["reqs"]]})
+        job["index"] += 1
+        if job["index"] < job["chunks"]:
+            return
+        self._pending.remove(job)
+        g.reserved.difference_update(job["slots"])
+        t0 = monotonic()
+        tok, done, caches = eng.serve_prefill_finish(
+            job["state"], job["live"], self._row_keys(job["ids"]))
+        tok_h = np.asarray(tok)
+        job["elapsed"] += monotonic() - t0
+        self.estimator.observe_prefill(g.bucket, job["elapsed"])
+        # requests whose deadline passed while their prompt was still
+        # chunking: finish as timeouts, splice only the survivors
+        now = self.now()
+        reqs, slots, src = [], [], []
+        for j, (req, slot) in enumerate(zip(job["reqs"], job["slots"])):
+            if req.finished:
+                continue
+            if req.deadline <= now:
+                self._complete(req, TIMEOUT, "expired during prefill")
+                continue
+            reqs.append(req)
+            slots.append(slot)
+            src.append(j)
+        if reqs:
+            self._splice(g, lane, reqs, slots, src, tok_h, caches,
+                         job["prompts"])
+
+    def _splice(self, g: _Group, lane: str, reqs: list, slots: list,
+                src: list, tok_h, caches, prompts) -> None:
+        """Merge cohort cache rows (and, on speculative lanes, the
+        cohort's draft cache rows) into the group and seat the requests."""
+        eng = self._engines[lane]
         if g.caches is None:
-            g.caches = self._empty_caches(eng, g.capacity, g.bucket, lane)
+            g.caches = self._empty_caches(eng.module, g.capacity,
+                                          g.bucket)
         g.caches = DecodeEngine.merge_cache_rows(
-            g.caches, caches, slots, list(range(k)), mesh=eng.mesh)
-        for j, (req, slot) in enumerate(zip(reqs, slots)):
+            g.caches, caches, slots, src, mesh=eng.mesh)
+        if eng.spec_tokens:
+            dc = eng.serve_draft_prefill(self._draft_vars, prompts)
+            if g.draft_caches is None:
+                g.draft_caches = self._empty_caches(
+                    eng.draft_module, g.capacity, g.bucket)
+            g.draft_caches = DecodeEngine.merge_cache_rows(
+                g.draft_caches, dc, slots, src, mesh=eng.mesh)
+        for j, (req, slot) in zip(src, zip(reqs, slots)):
             g.rows[slot] = req
             g.tok[slot] = tok_h[j]
             g.true_len[slot] = req.true_len
@@ -684,12 +881,13 @@ class ServingEngine:
             g.done[slot] = False
             trace_event("serve.join", cat="serve", request=req.id,
                         bucket=g.bucket, slot=slot, lane=lane)
+            self._record_serve({"event": "join", "request": req.id,
+                                "bucket": g.bucket, "slot": slot,
+                                "lane": lane})
             self._emit(g, slot, [int(tok_h[j])])
 
-    def _empty_caches(self, eng: DecodeEngine, capacity: int, bucket: int,
-                      lane: str) -> list:
+    def _empty_caches(self, module, capacity: int, bucket: int) -> list:
         import jax.numpy as jnp
-        module = eng.module
         dh = module.d_model // module.n_heads
         w0 = _round_up(bucket + 1, self.cfg.cache_chunk)
         shape = (capacity, w0, module.n_heads, dh)
@@ -720,7 +918,11 @@ class ServingEngine:
             req.note_tokens()
 
     def _advance(self, g: _Group, lane: str) -> None:
-        """Run one mixed-age segment for a group and harvest the results."""
+        """Run one mixed-age segment (or, on speculative lanes, one
+        draft-verify round) for a group and harvest the results."""
+        if self._engines[lane].spec_tokens:
+            self._advance_spec(g, lane)
+            return
         eng = self._engines[lane]
         variables = self._variables[lane]
         seg = self.cfg.segment_steps
@@ -740,6 +942,8 @@ class ServingEngine:
             tok_h = np.asarray(tok)
             done_h = np.asarray(done)
         self.estimator.observe_step(g.bucket, (monotonic() - t0) / seg)
+        self._record_serve({"event": "segment", "bucket": g.bucket,
+                            "lane": lane, "rows": len(live)})
         g.caches = caches
         g.tok = tok_h.astype(np.int32)
         g.done = done_h.astype(bool)
@@ -750,6 +954,65 @@ class ServingEngine:
             if g.rows[i] is not None:
                 g.t_row[i] += seg
         if self._run is not None:
+            self._run.gauge("serve.queue_depth", self.admission.pending())
+            self._run.gauge("serve.in_flight", self.in_flight())
+
+    def _advance_spec(self, g: _Group, lane: str) -> None:
+        """One speculative round: the draft proposes, one target forward
+        verifies, each row advances by its accepted count (+1).  The
+        estimator's per-step EWMA sees round time divided by tokens
+        actually emitted per live row — feasibility math tracks the
+        measured speculative speedup, not the optimistic bound."""
+        eng = self._engines[lane]
+        variables = self._variables[lane]
+        k1 = eng.spec_tokens + 1
+        live = g.live_slots()
+        max_t = int(g.t_row[live].max()) if live else 0
+        window = eng.serve_window(g.bucket, max_t, k1)
+        t0 = monotonic()
+        with span_on_tracer(self._tracer, "serve.spec_round", cat="serve",
+                            bucket=g.bucket, lane=lane, window=window,
+                            occupancy=round(len(live) / g.capacity, 3)):
+            (caches, draft_caches, toks, counts, tok, done,
+             accepted) = eng.serve_spec_round(
+                variables, self._draft_vars, g.caches, g.draft_caches,
+                np.asarray(g.tok), np.asarray(g.done), g.true_len,
+                g.budget, g.bucket, g.t_row, g.spec_rounds,
+                self._group_keys(g), window)
+            toks_h = np.asarray(toks)
+            counts_h = np.asarray(counts)
+            tok_h = np.asarray(tok)
+            done_h = np.asarray(done)
+            accepted_h = np.asarray(accepted)
+        elapsed = monotonic() - t0
+        g.spec_rounds += 1
+        emitted = int(counts_h[live].sum())
+        per_row = emitted / max(1, len(live))
+        self.estimator.observe_step(g.bucket, elapsed / max(1.0, per_row))
+        inc_counter("serve.spec_drafted_tokens",
+                    eng.spec_tokens * len(live))
+        inc_counter("serve.spec_accepted_tokens",
+                    int(accepted_h[live].sum()))
+        self._record_serve({"event": "segment", "bucket": g.bucket,
+                            "lane": lane, "rows": len(live),
+                            "spec": True, "emitted": emitted})
+        g.caches = caches
+        g.draft_caches = draft_caches
+        g.tok = tok_h.astype(np.int32)
+        g.done = done_h.astype(bool)
+        for i in live:
+            if g.rows[i] is None:
+                continue
+            take = int(counts_h[i])
+            if take:
+                self._emit(g, i, toks_h[i][:take].tolist())
+            if g.rows[i] is not None:
+                g.t_row[i] += take
+        if self._run is not None:
+            self._run.gauge(
+                "serve.spec_acceptance_rate",
+                round(float(accepted_h[live].sum())
+                      / max(1, eng.spec_tokens * len(live)), 4))
             self._run.gauge("serve.queue_depth", self.admission.pending())
             self._run.gauge("serve.in_flight", self.in_flight())
 

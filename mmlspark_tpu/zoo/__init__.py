@@ -1,6 +1,11 @@
 """Model zoo: repository client with integrity-checked downloads
-(reference downloader/)."""
+(reference downloader/) plus draft/target pairing for speculative
+decoding (speculative.py)."""
 
+from mmlspark_tpu.zoo.speculative import (
+    soften_late_blocks,
+    truncated_draft_bundle,
+)
 from mmlspark_tpu.zoo.downloader import (
     LocalRepo,
     ModelDownloader,
@@ -16,5 +21,5 @@ from mmlspark_tpu.zoo.downloader import (
 __all__ = [
     "ModelSchema", "ModelDownloader", "LocalRepo", "RemoteRepo",
     "ModelNotFoundError", "create_builtin_repo", "pretrained_repo", "pack_bundle",
-    "unpack_bundle",
+    "unpack_bundle", "truncated_draft_bundle", "soften_late_blocks",
 ]

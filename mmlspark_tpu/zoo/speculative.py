@@ -1,0 +1,113 @@
+"""Draft/target pairing for speculative decoding (models/generate.py).
+
+The engine's contract is architectural, not statistical: ANY draft LM
+with the same vocabulary yields byte-identical greedy output (and the
+target's sampling distribution, via rejection sampling) — only the
+acceptance rate, and hence the speedup, depends on how well the draft
+predicts the target.  This module builds the zero-training draft that
+works out of the box: a **layer-truncated self-draft** that runs the
+target's own first `n_layers` blocks and re-uses its embedding /
+final-norm / lm-head weights (the LayerSkip / early-exit construction).
+Nothing is copied — the draft bundle aliases the target's arrays, so a
+draft adds no parameter memory beyond its own KV cache.
+
+Why truncation beats a separately-trained small LM here: the truncated
+stack computes a prefix of the exact same residual stream the target
+reads its logits from, so agreement is high wherever the late blocks
+mostly refine rather than overturn the early prediction — the common
+regime for confident tokens, which are exactly the tokens speculation
+can batch.  And it needs no second checkpoint in the zoo.
+
+`soften_late_blocks` is the bench/test counterpart: it scales the
+residual-path *output* projections (attention proj, MLP down) of the
+target's late blocks toward zero, making the target provably
+draft-friendly — the truncated draft then agrees almost always, so
+bench speedups and acceptance-rate assertions are stable across seeds
+while greedy outputs remain byte-identical by construction (the
+speedup claim is never assumed, always measured).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from mmlspark_tpu.models.bundle import ModelBundle
+
+
+def _lm_fields(bundle: ModelBundle) -> tuple[int, str]:
+    cfg = bundle.config
+    if "n_layers" not in cfg or "vocab_size" not in cfg:
+        raise ValueError(
+            f"architecture {bundle.architecture!r} is not a generatable "
+            "LM bundle (no n_layers/vocab_size config)")
+    return int(cfg["n_layers"]), str(cfg.get("mlp_impl", "dense"))
+
+
+def truncated_draft_bundle(bundle: ModelBundle,
+                           n_layers: int = 1) -> ModelBundle:
+    """A draft LM that is the target's first `n_layers` blocks.
+
+    Shares (aliases) the target's tok_embed / pos_embed / early
+    block{i}_w / final_norm_w / lm_head arrays; the returned bundle's
+    config differs from the target's only in `n_layers`.  Pass the
+    result to TextGenerator.set_draft_bundle (or its module/variables
+    straight into DecodeEngine) alongside `specTokens`.
+
+    MoE targets are rejected up front — step-by-step decode routes a
+    different capacity group than batched verify, the same reason
+    DecodeEngine refuses MoE drafts.
+    """
+    total, mlp_impl = _lm_fields(bundle)
+    if mlp_impl == "moe":
+        raise ValueError(
+            "speculative decoding does not support MoE models: per-step "
+            "routing and batched verify route different capacity groups")
+    if not 1 <= n_layers <= total:
+        raise ValueError(
+            f"draft n_layers must be in [1, {total}], got {n_layers}")
+    params = bundle.variables["params"]
+    kept = {"tok_embed": params["tok_embed"],
+            "pos_embed": params["pos_embed"],
+            "final_norm_w": params["final_norm_w"],
+            "lm_head": params["lm_head"]}
+    for i in range(n_layers):
+        kept[f"block{i}_w"] = params[f"block{i}_w"]
+    variables = dict(bundle.variables)
+    variables["params"] = kept
+    config = dict(bundle.config)
+    config["n_layers"] = n_layers
+    # partition metadata intentionally dropped: draft params replicate
+    # (DRAFT_KV_CACHE_SPEC rides the data axis only)
+    metadata = {"speculative": {"draft_of": bundle.architecture,
+                                "target_layers": total,
+                                "draft_layers": n_layers}}
+    return ModelBundle(bundle.architecture, config, variables, metadata)
+
+
+def soften_late_blocks(bundle: ModelBundle, keep_layers: int,
+                       factor: float = 0.05) -> ModelBundle:
+    """A copy of `bundle` whose blocks `keep_layers..` have their
+    residual-path output projections (attention proj, MLP down) scaled
+    by `factor` — the late blocks then barely perturb the residual
+    stream, so `truncated_draft_bundle(result, keep_layers)` agrees
+    with it on almost every greedy token.  Bench/test harness only;
+    a real checkpoint's acceptance rate is whatever it is."""
+    import numpy as np
+
+    total, _ = _lm_fields(bundle)
+    if not 1 <= keep_layers <= total:
+        raise ValueError(
+            f"keep_layers must be in [1, {total}], got {keep_layers}")
+    params = dict(bundle.variables["params"])
+    for i in range(keep_layers, total):
+        block = {k: (dict(v) if isinstance(v, dict) else v)
+                 for k, v in params[f"block{i}_w"].items()}
+        for name in ("proj", "mlp_down"):
+            if name in block:
+                block[name] = {k: np.asarray(v) * factor
+                               for k, v in block[name].items()}
+        params[f"block{i}_w"] = block
+    variables = dict(bundle.variables)
+    variables["params"] = params
+    return dataclasses.replace(bundle, variables=variables,
+                               metadata=dict(bundle.metadata or {}))

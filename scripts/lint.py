@@ -60,6 +60,12 @@ Checks, per file:
     retry/breaker policies wrap EVERY byte on the wire;
     `native_loader.py` is whitelisted (its one `subprocess.run` compiles
     the optional native extension at import, pre-dating the service)
+  * unregistered Pallas kernels in `mmlspark_tpu/ops/` — every module
+    containing a `pallas_call` must have an entry in
+    `PALLAS_PARITY_TESTS` mapping it to an existing parity-test file
+    under `tests/`: a hand-written kernel without a reference-parity
+    suite is unreviewable (the XLA path silently drifts from it), so
+    the registry makes "which tests pin this kernel?" a lint question
   * tabs in indentation
 """
 
@@ -86,6 +92,9 @@ HOT_LOOP_FILES = {
     os.path.join("mmlspark_tpu", "stages", "basic.py"),
     os.path.join("mmlspark_tpu", "io", "image_reader.py"),
     os.path.join("mmlspark_tpu", "io", "files.py"),
+    # the fused decode kernel runs once per generated token inside the
+    # compiled serve/decode programs — the hottest read in the stack
+    os.path.join("mmlspark_tpu", "ops", "decode_attention.py"),
 }
 
 # whole directories on the hot path: every quant/ module runs inside the
@@ -135,6 +144,18 @@ TRANSPORT_WHITELIST = {
 _SOCKET_CTOR_NAMES = ("create_connection", "create_server", "socketpair")
 _SUBPROCESS_CALL_NAMES = ("Popen", "run", "call", "check_call",
                           "check_output", "getoutput", "getstatusoutput")
+
+# hand-written Pallas kernels must carry a reference-parity suite: any
+# ops/ module with a `pallas_call` site needs an entry here pointing at
+# the tests that pin kernel-vs-XLA agreement (tolerance per dtype), so a
+# new kernel can't land without the check that notices it drifting
+OPS_DIR = os.path.join("mmlspark_tpu", "ops")
+PALLAS_PARITY_TESTS = {
+    os.path.join("mmlspark_tpu", "ops", "flash_attention.py"):
+        os.path.join("tests", "test_flash_attention.py"),
+    os.path.join("mmlspark_tpu", "ops", "decode_attention.py"):
+        os.path.join("tests", "test_decode_attention.py"),
+}
 
 # the parallel package: with_sharding_constraint / NamedSharding
 # construction anywhere else in mmlspark_tpu/ bypasses the partition
@@ -327,6 +348,19 @@ def _is_named_sharding_ctor(node: ast.Call) -> bool:
     return isinstance(fn, ast.Attribute) and fn.attr == "NamedSharding"
 
 
+def _in_ops(path: str) -> bool:
+    return os.path.normpath(path).startswith(OPS_DIR + os.sep)
+
+
+def _is_pallas_call(node: ast.Call) -> bool:
+    """Matches `pl.pallas_call(...)` / `pallas.pallas_call(...)` and the
+    bare `pallas_call(...)` from-import form (any attribute chain)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "pallas_call"
+    return isinstance(fn, ast.Attribute) and fn.attr == "pallas_call"
+
+
 def _is_print_call(node: ast.Call) -> bool:
     return isinstance(node.func, ast.Name) and node.func.id == "print"
 
@@ -408,7 +442,12 @@ def check_file(path: str) -> list[str]:
     in_data_policy = _in_data_policy(path)
     in_transport_policy = _in_transport_policy(path)
     in_sharding_policy = _in_sharding_policy(path)
+    in_ops = _in_ops(path)
+    pallas_line = None
     for node in ast.walk(tree):
+        if in_ops and isinstance(node, ast.Call) and _is_pallas_call(node) \
+                and pallas_line is None:
+            pallas_line = node.lineno
         if in_sharding_policy and isinstance(node, ast.Call):
             if _is_sharding_constraint_call(node):
                 problems.append(
@@ -510,6 +549,20 @@ def check_file(path: str) -> list[str]:
                 f"{path}:{node.lineno}: {node.attr} in a hot-loop module "
                 f"— float64 device feeds double transfer bytes (or get "
                 f"silently downcast); use float32/bfloat16")
+
+    if pallas_line is not None:
+        registered = PALLAS_PARITY_TESTS.get(os.path.normpath(path))
+        if registered is None:
+            problems.append(
+                f"{path}:{pallas_line}: pallas_call without a registered "
+                f"parity suite — add a PALLAS_PARITY_TESTS entry in "
+                f"scripts/lint.py mapping this module to the tests/ file "
+                f"that pins kernel-vs-reference agreement")
+        elif not os.path.exists(registered):
+            problems.append(
+                f"{path}:{pallas_line}: PALLAS_PARITY_TESTS points at "
+                f"'{registered}' which does not exist — the kernel's "
+                f"parity suite is gone")
 
     if os.path.basename(path) != "__init__.py":
         used = used_names(tree)

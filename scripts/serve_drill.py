@@ -2,7 +2,7 @@
 """Serving chaos drills: prove the engine sheds, degrades, and drains —
 never stalls, never corrupts.
 
-Four scenarios through the PR-7 `Scenario` DSL (resilience/chaos.py),
+Five scenarios through the PR-7 `Scenario` DSL (resilience/chaos.py),
 each driving a REAL threaded ServingEngine (and, where the fault is a
 client behavior, the real HTTP front end) with a scripted fault from the
 injector:
@@ -19,6 +19,11 @@ injector:
                       cancel in-flight by deadline, exit — and every
                       token served (complete or partial) is a prefix of
                       the offline reference
+  chunked_prefill     a long prompt lands while short requests decode:
+                      its prefill must run as per-tick chunks, the
+                      residents must keep their segment cadence between
+                      chunk ticks (asserted from the run_summary serve
+                      timeline), and every output stays byte-exact
 
 Corruption check: greedy decode is deterministic, so each completed
 response must EXACTLY equal `DecodeEngine.generate`'s offline tokens for
@@ -328,6 +333,98 @@ def scenario_midflight_sigterm(bundle):
     return run_scenario(scenario, run)
 
 
+def scenario_chunked_prefill(bundle):
+    """A 40-token prompt (48 bucket = 3 x 16 chunks) arrives while two
+    short requests are decoding: the prefill must spread over chunk
+    ticks instead of blocking the loop, and every completion must still
+    match the offline whole-prefill reference byte-exactly.  The cadence
+    half of the contract is asserted from the run_summary serve timeline
+    by `check_chunked_timeline` after the drill."""
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+
+    scenario = Scenario(
+        "chunked_prefill",
+        faults=[Fault(kind="burst", at_request=2, size=1)],
+        expect={"ok": 3, "corrupt": 0, "unfinished": 0,
+                "long_exact": True, "drained": True})
+
+    def run():
+        from mmlspark_tpu.resilience.chaos import get_injector
+        from mmlspark_tpu.serve.lifecycle import start_engine
+
+        engine = make_engine(bundle, prefill_chunk=16, segment_steps=2)
+        start_engine(engine)
+        injector = get_injector()
+        rng = np.random.default_rng(5)
+        requests, long_req = [], None
+        for i in range(1, 3):
+            for fault in injector.serve_faults_due(i):
+                if fault.kind == "burst":
+                    # the "burst" is one LONG arrival: a 40-token prompt
+                    # whose 48-slot bucket prefills in three 16-token
+                    # chunks while the residents keep decoding
+                    long_prompt = rng.integers(0, 64, (40,)).astype(
+                        np.int32)
+                    long_req = engine.submit(long_prompt,
+                                             max_new_tokens=8,
+                                             deadline_s=60.0)
+                    requests.append(long_req)
+            prompt = rng.integers(0, 64, (5,)).astype(np.int32)
+            requests.append(engine.submit(prompt, max_new_tokens=16,
+                                          deadline_s=60.0))
+        for req in requests:
+            req.wait(60.0)
+        engine.stop()
+        refs = {req.id: reference_tokens(bundle, req.prompt.tolist(),
+                                         req.max_new_tokens)
+                for req in requests}
+        exact, prefix, corrupt = check_outputs(bundle, requests, refs)
+        return {"ok": sum(1 for r in requests if r.status == "ok"),
+                "corrupt": corrupt,
+                "unfinished": sum(1 for r in requests if not r.finished),
+                "long_exact": bool(long_req is not None
+                                   and long_req.status == "ok"
+                                   and long_req.tokens
+                                   == refs[long_req.id]),
+                "drained": engine.state == "stopped"}
+
+    return run_scenario(scenario, run)
+
+
+def check_chunked_timeline(summary: dict) -> dict:
+    """The cadence half of the chunked-prefill contract, read off the
+    run_summary.json serve timeline: the long prompt's prefill appears
+    as 3 ordered `prefill_chunk` ticks, the resident short-bucket lane
+    emits `segment` events BETWEEN those ticks — decode never paused for
+    the prefill — and the cohort's `join` lands only after the last
+    chunk."""
+    serve = summary.get("serve", [])
+    chunk_idx = [i for i, e in enumerate(serve)
+                 if e.get("event") == "prefill_chunk"]
+    indices = [serve[i].get("index") for i in chunk_idx]
+    segs_between = [
+        i for i, e in enumerate(serve)
+        if e.get("event") == "segment" and e.get("bucket") != 48
+        and chunk_idx and chunk_idx[0] < i < chunk_idx[-1]]
+    join_after = any(
+        e.get("event") == "join" and e.get("bucket") == 48
+        and chunk_idx and i > chunk_idx[-1]
+        for i, e in enumerate(serve))
+    checks = {
+        "three_chunk_ticks": indices == [0, 1, 2],
+        "resident_cadence_held": (
+            len(chunk_idx) > 1
+            and len(segs_between) >= len(chunk_idx) - 1),
+        "join_after_last_chunk": join_after,
+    }
+    return {"name": "chunked_prefill_timeline",
+            "passed": all(checks.values()),
+            "checks": {k: {"want": True, "got": v, "ok": bool(v)}
+                       for k, v in checks.items()},
+            "observed": {"chunk_indices": indices,
+                         "segments_between": len(segs_between)}}
+
+
 def check_timeline(summary: dict) -> dict:
     """The run_summary.json serve timeline must carry the lifecycle
     events the scenarios exercised, in a sane order per drain."""
@@ -362,10 +459,13 @@ def main() -> int:
         with run_telemetry(td) as rt:
             for scenario_fn in (scenario_burst, scenario_hung_client,
                                 scenario_poison,
-                                scenario_midflight_sigterm):
+                                scenario_midflight_sigterm,
+                                scenario_chunked_prefill):
                 reports.append(scenario_fn(bundle))
             summary = rt.summary()
-        reports.append(check_timeline(rt.finish() or summary))
+        final = rt.finish() or summary
+        reports.append(check_timeline(final))
+        reports.append(check_chunked_timeline(final))
 
     passed = all(r["passed"] for r in reports)
     if args.json:

@@ -1,0 +1,134 @@
+"""Fused single-query attention vs the reference cache read
+(ops/decode_attention.py vs ops/attention.single_query_attention).
+
+Runs the kernel through the Pallas interpreter on CPU (`interpret=True`);
+on a real TPU the same cases compile it.  This file is the registered
+parity suite for the module's `pallas_call` site (scripts/lint.py's
+pallas-parity registry)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.ops.attention import single_query_attention
+from mmlspark_tpu.ops.decode_attention import fused_single_query_attention
+from mmlspark_tpu.quant.quantize import quantize_kv
+
+ON_TPU = "tpu" in getattr(jax.devices()[0], "device_kind", "").lower()
+TOL = dict(rtol=1e-2, atol=1e-2) if ON_TPU else dict(rtol=2e-5, atol=2e-5)
+
+
+def _case(b=2, l=128, h=4, d=32, dtype=jnp.float32, seed=0, true_len=None,
+          frontier=None):
+    """A decode-step read: per-row prompt slots [0, true_len) plus decode
+    slots [l // 2, frontier] visible — the engine's bucketed layout with a
+    per-row pad hole between prompt and decode slots."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, l, h, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, l, h, d)), dtype)
+    true_len = true_len if true_len is not None else \
+        rng.integers(1, l // 2, size=b)
+    frontier = frontier if frontier is not None else l // 2
+    slots = np.arange(l)[None, :]
+    visible = (slots < np.asarray(true_len)[:, None]) | \
+        ((slots >= l // 2) & (slots <= frontier))
+    return q, k, v, jnp.asarray(visible)
+
+
+def _assert_parity(q, k, v, visible, k_scale=None, v_scale=None,
+                   block_k=64, tol=TOL):
+    ref = single_query_attention(q, k, v, visible, k_scale=k_scale,
+                                 v_scale=v_scale)
+    got = fused_single_query_attention(q, k, v, visible, k_scale=k_scale,
+                                       v_scale=v_scale, block_k=block_k,
+                                       interpret=True)
+    assert got.dtype == jnp.float32 and got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **tol)
+
+
+@pytest.mark.parametrize("block_k", [32, 64, 128])
+def test_matches_reference_f32(block_k):
+    _assert_parity(*_case(), block_k=block_k)
+
+
+def test_matches_reference_bf16():
+    q, k, v, visible = _case(dtype=jnp.bfloat16, seed=1)
+    # both paths cast the bf16 cache to f32 before the dot, so they agree
+    # to f32 rounding, not bf16 rounding
+    _assert_parity(q, k, v, visible)
+
+
+def test_matches_reference_int8_kv():
+    """The in-kernel dequant (k_scale after QK^T, v_scale folded into the
+    weights) against the reference's identical algebraic hoist."""
+    q, k, v, visible = _case(seed=2)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    _assert_parity(q, kq, vq, visible, k_scale=ks, v_scale=vs)
+
+
+def test_int8_zero_slots():
+    """Never-written cache slots are int8 zeros with scale 0 — visible or
+    not, both paths must treat them as exact-zero keys/values."""
+    q, k, v, visible = _case(seed=3)
+    k = k.at[:, 100:].set(0.0)
+    v = v.at[:, 100:].set(0.0)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    assert float(jnp.abs(ks[:, 100:]).max()) == 0.0
+    # make a zeroed slot visible on every row: scale-0 dequant must
+    # reproduce exact zeros, not NaNs, in both implementations
+    visible = visible.at[:, 100].set(True)
+    _assert_parity(q, kq, vq, visible, k_scale=ks, v_scale=vs)
+
+
+def test_window_edges():
+    """Visibility frontiers on and off block boundaries, including a row
+    whose only visible slot is the last of the window."""
+    q, k, v, _ = _case(b=4, seed=4)
+    slots = np.arange(128)[None, :]
+    visible = np.stack([
+        (slots[0] < 63),            # frontier one short of a block edge
+        (slots[0] < 64),            # exactly a block edge
+        (slots[0] < 65),            # one past a block edge
+        (slots[0] == 127),          # single visible slot, last of window
+    ])
+    _assert_parity(q, k, v, jnp.asarray(visible))
+
+
+def test_single_block_and_odd_batch():
+    q, k, v, visible = _case(b=3, l=64, seed=5)
+    _assert_parity(q, k, v, visible, block_k=64)
+
+
+def test_scale_override():
+    q, k, v, visible = _case(seed=6)
+    ref = single_query_attention(q, k, v, visible, scale=0.25)
+    got = fused_single_query_attention(q, k, v, visible, scale=0.25,
+                                       interpret=True, block_k=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **TOL)
+
+
+def test_non_tiling_window_falls_back():
+    """A window that doesn't tile block_k must agree exactly with the
+    reference (it IS the reference, via the checked fallback)."""
+    q, k, v, visible = _case(l=96, seed=7)
+    ref = single_query_attention(q, k, v, visible)
+    got = fused_single_query_attention(q, k, v, visible, block_k=64,
+                                       interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_auto_interpret_off_tpu_is_reference():
+    """interpret=None on a non-TPU host resolves to the reference path —
+    the tier-1 fallback the engine's decode step relies on."""
+    if ON_TPU:
+        pytest.skip("auto mode compiles the kernel on TPU")
+    q, k, v, visible = _case(seed=8)
+    ref = single_query_attention(q, k, v, visible)
+    got = fused_single_query_attention(q, k, v, visible)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=0, atol=0)

@@ -1,0 +1,330 @@
+"""Speculative decoding (models/generate.py draft/verify rounds +
+zoo/speculative.py draft construction): greedy outputs must be
+byte-identical to the plain engine under every cache/window/draft
+configuration — acceptance only moves THROUGHPUT — the rejection
+sampler must preserve the target distribution (seeded statistical pin),
+chunked prefill must be pure layout, and the min_new_tokens floor must
+skip the between-segment early-exit syncs it makes provably dead."""
+
+import jax
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataTable
+from mmlspark_tpu.models import ModelBundle
+from mmlspark_tpu.models.definitions import build_model
+from mmlspark_tpu.models.generate import (DecodeEngine, TextGenerator,
+                                          decode_segments)
+from mmlspark_tpu.zoo import soften_late_blocks, truncated_draft_bundle
+
+CFG = {"vocab_size": 32, "d_model": 32, "n_heads": 4, "n_layers": 3,
+       "max_len": 64, "dtype": "float32"}
+
+
+@pytest.fixture(scope="module")
+def target():
+    module = build_model("TransformerLM", CFG)
+    variables = module.init(jax.random.key(11),
+                            np.zeros((1, 4), np.int32))
+    return ModelBundle.from_module(module, variables)
+
+
+@pytest.fixture(scope="module")
+def draft(target):
+    return truncated_draft_bundle(target, n_layers=1)
+
+
+def _ragged_rows(lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG["vocab_size"], (n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _engine_generate(engine, variables, rows, draft_variables=None):
+    """Group rows by bucket and decode (the transform grouping, inlined —
+    see test_decode_engine.py)."""
+    out = [None] * len(rows)
+    by_bucket = {}
+    for i, r in enumerate(rows):
+        by_bucket.setdefault(engine.bucket_for(len(r)), []).append(i)
+    kw = {}
+    if draft_variables is not None:
+        kw["draft_variables"] = draft_variables
+    for bucket, idxs in sorted(by_bucket.items()):
+        prompts = np.zeros((len(idxs), bucket), np.int32)
+        tl = np.asarray([len(rows[i]) for i in idxs], np.int32)
+        for j, i in enumerate(idxs):
+            prompts[j, :tl[j]] = rows[i]
+        got = engine.generate(variables, prompts, tl,
+                              row_ids=np.asarray(idxs, np.int32), **kw)
+        for j, i in enumerate(idxs):
+            out[i] = got[j]
+    return out
+
+
+# ------------------------------------------------- draft construction ---
+
+def test_truncated_draft_aliases_target(target, draft):
+    """The draft is the target's first m layers + unembedding, aliased —
+    zero extra parameter memory, no training step."""
+    assert draft.config["n_layers"] == 1
+    tp, dp = target.variables["params"], draft.variables["params"]
+    for path in (("tok_embed", "embedding"), ("final_norm_w", "scale"),
+                 ("lm_head", "kernel"), ("block0_w", "qkv", "kernel")):
+        t_leaf, d_leaf = tp, dp
+        for k in path:
+            t_leaf, d_leaf = t_leaf[k], d_leaf[k]
+        assert np.shares_memory(np.asarray(t_leaf), np.asarray(d_leaf))
+    assert "block1_w" not in dp and "block2_w" not in dp
+    meta = draft.metadata["speculative"]
+    assert meta["target_layers"] == 3 and meta["draft_layers"] == 1
+
+
+def test_truncated_draft_validation(target):
+    with pytest.raises(ValueError, match="n_layers"):
+        truncated_draft_bundle(target, n_layers=0)
+    with pytest.raises(ValueError, match="n_layers"):
+        truncated_draft_bundle(target, n_layers=4)
+    moe = ModelBundle(target.architecture,
+                      {**target.config, "mlp_impl": "moe"},
+                      target.variables, {})
+    with pytest.raises(ValueError, match="[Mm]o[Ee]"):
+        truncated_draft_bundle(moe, n_layers=1)
+
+
+def test_soften_late_blocks_zeroes_projections(target):
+    """factor=0.0 makes late blocks' residual contributions exactly
+    zero, so the softened model IS its own first-k-layer truncation —
+    the acceptance~1.0 pairing the bench uses; the input is untouched."""
+    soft = soften_late_blocks(target, keep_layers=1, factor=0.0)
+    p, sp = target.variables["params"], soft.variables["params"]
+    for blk in ("block1_w", "block2_w"):
+        for leaf in ("proj", "mlp_down"):
+            assert not np.asarray(sp[blk][leaf]["kernel"]).any()
+            assert np.asarray(p[blk][leaf]["kernel"]).any()
+    # kept layers and everything else are byte-identical
+    np.testing.assert_array_equal(
+        np.asarray(sp["block0_w"]["proj"]["kernel"]),
+        np.asarray(p["block0_w"]["proj"]["kernel"]))
+
+
+# ------------------------------------ greedy byte-exactness (the pin) ---
+
+@pytest.mark.parametrize("chunk,cache_dtype,k", [
+    (8, "model", 3), (8, "int8", 4)])
+def test_spec_greedy_byte_exact(target, draft, chunk, cache_dtype, k):
+    """THE speculative contract: greedy tokens through draft/verify
+    rounds are byte-identical to the plain engine's — with a raw
+    truncated draft (acceptance well below 1, so rejection/correction
+    paths are exercised), across cache windows and int8 KV."""
+    module = target.module()
+    rows = _ragged_rows([3, 5, 8, 9], seed=chunk)
+    base = DecodeEngine(module, 12, chunk=chunk, cache_dtype=cache_dtype)
+    spec = DecodeEngine(module, 12, chunk=chunk, cache_dtype=cache_dtype,
+                        draft_module=draft.module(), spec_tokens=k)
+    want = _engine_generate(base, target.variables, rows)
+    got = _engine_generate(spec, target.variables, rows,
+                           draft_variables=draft.variables)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g, w)
+    assert spec.last_spec_rounds > 0
+    # acceptance is a rate over the LAST generate call; a 1-row bucket
+    # can legitimately reject every first draft, so only bound it
+    assert 0.0 <= spec.last_spec_acceptance <= 1.0
+    assert spec.last_spec_accepted <= spec.last_spec_drafted
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("chunk,cache_dtype,k", [
+    (16, "model", 7), (32, "int8", 2)])
+def test_spec_greedy_byte_exact_slow(target, draft, chunk, cache_dtype,
+                                     k):
+    test_spec_greedy_byte_exact(target, draft, chunk, cache_dtype, k)
+
+
+def test_spec_greedy_exact_with_stops_and_floor(target, draft):
+    """Stops + min_new_tokens compose with speculation: the spec engine
+    freezes on the same token at the same index as the plain engine."""
+    module = target.module()
+    rows = _ragged_rows([4, 6], seed=9)
+    free = DecodeEngine(module, 16, chunk=8)
+    base_out = _engine_generate(free, target.variables, rows)
+    stop = int(base_out[0][1])
+    kw = dict(chunk=8, stop_tokens=(stop,), min_new_tokens=3)
+    base = DecodeEngine(module, 16, **kw)
+    spec = DecodeEngine(module, 16, draft_module=draft.module(),
+                        spec_tokens=3, **kw)
+    want = _engine_generate(base, target.variables, rows)
+    got = _engine_generate(spec, target.variables, rows,
+                           draft_variables=draft.variables)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_spec_engine_validation(target, draft):
+    module = target.module()
+    with pytest.raises(ValueError, match="draft_module"):
+        DecodeEngine(module, 8, spec_tokens=3)
+    with pytest.raises(ValueError, match="spec_tokens"):
+        DecodeEngine(module, 8, draft_module=draft.module())
+    small = build_model("TransformerLM", {**CFG, "vocab_size": 16})
+    with pytest.raises(ValueError, match="vocab"):
+        DecodeEngine(module, 8, draft_module=small, spec_tokens=3)
+    spec = DecodeEngine(module, 8, draft_module=draft.module(),
+                        spec_tokens=3)
+    with pytest.raises(ValueError, match="draft_variables"):
+        spec.generate(target.variables, np.zeros((1, 8), np.int32),
+                      np.asarray([4]))
+
+
+# ------------------------------------------- rejection sampler (pin) ---
+
+def test_spec_sampler_preserves_target_distribution(target, draft):
+    """The rejection sampler's correctness, pinned statistically: 512
+    rows share one prompt at temperature 1.0; the first SPEC-COMMITTED
+    token (index 1 — index 0 is prefill-sampled) must be distributed as
+    the target model's softmax conditioned on each row's actual first
+    token.  Total-variation distance to the analytic mixture stays
+    under 0.15 (seeded, so this is deterministic), and the same seed
+    reproduces byte-identically."""
+    module = target.module()
+    b = 512
+    prompt = np.asarray([7, 3, 11], np.int32)
+    spec = DecodeEngine(module, 4, temperature=1.0, chunk=16,
+                        draft_module=draft.module(), spec_tokens=3)
+    prompts = np.zeros((b, spec.bucket_for(len(prompt))), np.int32)
+    prompts[:, :len(prompt)] = prompt
+    tl = np.full(b, len(prompt), np.int32)
+    out = spec.generate(target.variables, prompts, tl,
+                        rng=jax.random.key(5),
+                        draft_variables=draft.variables)
+    again = spec.generate(target.variables, prompts, tl,
+                          rng=jax.random.key(5),
+                          draft_variables=draft.variables)
+    np.testing.assert_array_equal(out, again)
+
+    # analytic mixture: mean over rows of p(token_1 | prompt, token_0)
+    vocab = CFG["vocab_size"]
+    tok0 = out[:, 0]
+    prefixes = np.concatenate(
+        [np.tile(prompt, (b, 1)), tok0[:, None]], axis=1).astype(np.int32)
+    logits = np.asarray(module.apply(target.variables,
+                                     prefixes))[:, -1, :]
+    z = logits - logits.max(axis=-1, keepdims=True)
+    probs = np.exp(z) / np.exp(z).sum(axis=-1, keepdims=True)
+    mixture = probs.mean(axis=0)
+    freq = np.bincount(out[:, 1], minlength=vocab) / b
+    tv = 0.5 * np.abs(freq - mixture).sum()
+    assert tv < 0.15, f"TV {tv:.3f} from the target distribution"
+    # and the sampled path really speculated
+    assert spec.last_spec_rounds > 0
+
+
+# ------------------------------------------- chunked prefill parity ---
+
+@pytest.mark.parametrize("cache_dtype", ["model", "int8"])
+def test_chunked_prefill_parity(target, cache_dtype):
+    """Chunked prefill is pure scheduling: outputs are byte-identical to
+    whole-prompt prefill, for buckets that chunk (16, 32 at chunk 8) and
+    buckets that don't (8 <= chunk stays whole)."""
+    module = target.module()
+    rows = _ragged_rows([3, 9, 16, 20], seed=4)
+    whole = DecodeEngine(module, 10, chunk=16, cache_dtype=cache_dtype)
+    chunked = DecodeEngine(module, 10, chunk=16, cache_dtype=cache_dtype,
+                           prefill_chunk=8)
+    assert chunked.serve_prefill_chunks(32) == 4
+    assert chunked.serve_prefill_chunks(8) == 0
+    want = _engine_generate(whole, target.variables, rows)
+    got = _engine_generate(chunked, target.variables, rows)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_chunked_prefill_composes_with_speculation(target, draft):
+    module = target.module()
+    rows = _ragged_rows([5, 18], seed=6)
+    base = DecodeEngine(module, 8, chunk=16)
+    both = DecodeEngine(module, 8, chunk=16, prefill_chunk=8,
+                        draft_module=draft.module(), spec_tokens=3)
+    want = _engine_generate(base, target.variables, rows)
+    got = _engine_generate(both, target.variables, rows,
+                           draft_variables=draft.variables)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g, w)
+
+
+# --------------------------------- min_new_tokens early-exit skipping ---
+
+def test_min_new_floor_skips_dead_exit_checks(target):
+    """With min_new_tokens = max_new_tokens no segment can possibly see
+    an all-done batch, so every between-segment device->host sync is
+    skipped (counted on the engine + the decode gauge); the output still
+    equals the stop-free decode byte-exactly."""
+    module = target.module()
+    rows = _ragged_rows([5, 6], seed=2)
+    free = DecodeEngine(module, 24, chunk=8)
+    base = _engine_generate(free, target.variables, rows)
+    stop = int(base[0][1])
+    pinned = DecodeEngine(module, 24, chunk=8, stop_tokens=(stop,),
+                          min_new_tokens=24)
+    got = _engine_generate(pinned, target.variables, rows)
+    n_segs = len(decode_segments(pinned.bucket_for(5), 24, 8))
+    assert pinned.last_exit_checks_skipped == n_segs
+    for g, b_ in zip(got, base):
+        np.testing.assert_array_equal(g, b_)
+    # floor 1: every check runs (the counter is really counting)
+    eager = DecodeEngine(module, 24, chunk=8, stop_tokens=(stop,))
+    _engine_generate(eager, target.variables, rows)
+    assert eager.last_exit_checks_skipped == 0
+
+
+def test_min_new_floor_defers_stop_freeze(target):
+    """A stop token before the floor does NOT freeze the row: tokens up
+    to the floor match the stop-free decode, and the freeze lands on the
+    first stop at index >= min_new_tokens - 1."""
+    module = target.module()
+    rows = _ragged_rows([5], seed=2)
+    free = DecodeEngine(module, 12, chunk=8)
+    base = _engine_generate(free, target.variables, rows)[0]
+    stop = int(base[1])  # would freeze at index 1 without the floor
+    floored = DecodeEngine(module, 12, chunk=8, stop_tokens=(stop,),
+                           min_new_tokens=6)
+    got = _engine_generate(floored, target.variables, rows)[0]
+    np.testing.assert_array_equal(got[:6], base[:6])
+    hits = np.nonzero(got == stop)[0]
+    first_freeze = [i for i in hits if i >= 5]
+    if first_freeze:
+        assert (got[first_freeze[0]:] == stop).all()
+
+
+# ------------------------------------------- transform-level plumbing ---
+
+def test_textgenerator_spec_plumbing(target, draft):
+    rows = np.empty(3, object)
+    for j, n in enumerate([3, 5, 9]):
+        rows[j] = (np.arange(n, dtype=np.int32) * 3 + j) % 32
+    table = DataTable({"prompt": rows})
+    plain = TextGenerator(target, inputCol="prompt", outputCol="out",
+                          maxNewTokens=8).transform(table)["out"]
+    gen = TextGenerator(target, inputCol="prompt", outputCol="out",
+                        maxNewTokens=8, specTokens=3)
+    with pytest.raises(ValueError, match="set_draft_bundle"):
+        gen.transform(table)
+    gen.set_draft_bundle(draft)
+    spec = gen.transform(table)["out"]
+    for a, b_ in zip(plain, spec):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_textgenerator_prefill_chunk_parity(target):
+    rows = np.empty(2, object)
+    rows[0] = np.arange(20, dtype=np.int32) % 32
+    rows[1] = np.arange(4, dtype=np.int32)
+    table = DataTable({"prompt": rows})
+    plain = TextGenerator(target, inputCol="prompt", outputCol="out",
+                          maxNewTokens=6).transform(table)["out"]
+    chunked = TextGenerator(target, inputCol="prompt", outputCol="out",
+                            maxNewTokens=6,
+                            prefillChunk=8).transform(table)["out"]
+    for a, b_ in zip(plain, chunked):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))

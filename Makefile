@@ -1,6 +1,6 @@
 # Developer entry points (the reference's `runme` + sbt targets,
 # tools/runme/runme.sh:30-52 + src/project/build.scala).
-.PHONY: check check-full test test-full lint bench bench-smoke bench-history chaos-drill serve-drill router-drill data-drill tpu-floors install docs notebooks clean
+.PHONY: check check-full test test-full lint bench bench-smoke bench-history chaos-drill serve-drill router-drill data-drill disagg-drill tpu-floors install docs notebooks clean
 
 check:            ## full gate: syntax + lint + suite + dryrun + bench smoke
 	bash scripts/check.sh
@@ -40,6 +40,9 @@ router-drill:     ## replica chaos scenarios: crash failover, hang ejection, ret
 
 data-drill:       ## data-service chaos scenarios: worker crash re-dispatch, dynamic exactly-once, slow-worker load shift, fleet respawn (scripts/data_drill.py)
 	python scripts/data_drill.py
+
+disagg-drill:     ## disaggregated-tier chaos scenarios: prefill-burst interference, torn/stalled/crashed KV handoff, prefill-tier drain (scripts/disagg_drill.py)
+	python scripts/disagg_drill.py
 
 tpu-floors:       ## throughput/MFU floors on a real TPU chip
 	MMLSPARK_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_perf_floor.py -q

@@ -16,6 +16,13 @@ failure (`err`), shutdown (`stop`).  Type ``E``: one ready element —
 `[4B split][4B seq]` + pickled payload, the hot path.  Sequence
 numbers are per-(split, attempt), which is what lets the dispatcher
 deduplicate redelivered elements after a crash re-dispatch.
+Type ``K``: one KV-cache page for the serving tier's prefill->decode
+handoff — `[4B request id][4B chunk index][4B byte length][4B crc32]`
++ raw page bytes.  The crc is validated at parse time: a bit-flipped
+page surfaces as a typed `TransportError` (carrying `request_id` /
+`page_index`) instead of a silently corrupt cache splice, and the bad
+frame is consumed first so the stream stays parseable — one torn page
+fails one transfer, not the whole link.
 
 Reads on the dispatcher side are non-blocking (`recv_ready` +
 `FrameBuffer`) so one consumer thread can pump every worker; writes
@@ -32,24 +39,41 @@ import socket
 import struct
 import subprocess
 import sys
+import zlib
 from typing import Iterator, Optional
+
+from mmlspark_tpu.observe.spans import monotonic
 
 _HDR = struct.Struct(">IB")
 _ELEM = struct.Struct(">II")
+_PAGE = struct.Struct(">IIII")  # request id, chunk index, byte len, crc32
 _TYPE_JSON = 0x4A   # 'J'
 _TYPE_ELEM = 0x45   # 'E'
+_TYPE_PAGE = 0x4B   # 'K'
 _MAX_FRAME = 1 << 31
 
 
 class TransportError(ConnectionError):
     """Framing/peer failure on a service connection (retryable class:
-    subclasses ConnectionError so `default_classify` retries it)."""
+    subclasses ConnectionError so `default_classify` retries it).
+    Page-integrity failures set `request_id`/`page_index` so the caller
+    can fail ONE transfer instead of the whole link."""
+
+    def __init__(self, message: str, *, request_id: Optional[int] = None,
+                 page_index: Optional[int] = None):
+        super().__init__(message)
+        self.request_id = request_id
+        self.page_index = page_index
+
+
+def encode_json(msg: dict) -> bytes:
+    import json
+    payload = json.dumps(msg, sort_keys=True).encode("utf-8")
+    return _HDR.pack(len(payload) + 1, _TYPE_JSON) + payload
 
 
 def send_json(sock: socket.socket, msg: dict) -> None:
-    import json
-    payload = json.dumps(msg, sort_keys=True).encode("utf-8")
-    sock.sendall(_HDR.pack(len(payload) + 1, _TYPE_JSON) + payload)
+    sock.sendall(encode_json(msg))
 
 
 def send_elem(sock: socket.socket, split: int, seq: int, obj) -> None:
@@ -58,15 +82,36 @@ def send_elem(sock: socket.socket, split: int, seq: int, obj) -> None:
     sock.sendall(_HDR.pack(len(payload) + 1, _TYPE_ELEM) + payload)
 
 
+def encode_page(request_id: int, page_index: int, data: bytes) -> bytes:
+    """One KV page frame: the (request id, chunk index, byte length,
+    crc32) header the handoff protocol acks against, then the raw page.
+    Encoding is split from sending so the serving tier's single-threaded
+    pump can queue frames on a non-blocking socket."""
+    payload = _PAGE.pack(request_id, page_index, len(data),
+                         zlib.crc32(data)) + data
+    return _HDR.pack(len(payload) + 1, _TYPE_PAGE) + payload
+
+
+def send_page(sock: socket.socket, request_id: int, page_index: int,
+              data: bytes) -> None:
+    sock.sendall(encode_page(request_id, page_index, data))
+
+
 class FrameBuffer:
     """Incremental frame parser: `feed` raw bytes, iterate `frames()`.
-    Frames come out as ("json", dict) or ("elem", split, seq, obj)."""
+    Frames come out as ("json", dict), ("elem", split, seq, obj), or
+    ("page", request_id, page_index, data) — page frames crc-validated
+    at parse time (a failed page raises `TransportError` AFTER consuming
+    the frame, so iteration can resume on the next frame)."""
 
     def __init__(self):
         self._buf = bytearray()
 
     def feed(self, data: bytes) -> None:
         self._buf.extend(data)
+
+    def pending(self) -> int:
+        return len(self._buf)
 
     def frames(self) -> Iterator[tuple]:
         import json
@@ -87,8 +132,61 @@ class FrameBuffer:
                 split, seq = _ELEM.unpack_from(payload)
                 yield ("elem", split, seq,
                        pickle.loads(payload[_ELEM.size:]))
+            elif ftype == _TYPE_PAGE:
+                yield self._page(payload)
             else:
                 raise TransportError(f"unknown frame type {ftype:#x}")
+
+    @staticmethod
+    def _page(payload: bytes) -> tuple:
+        if len(payload) < _PAGE.size:
+            raise TransportError(
+                f"truncated page header ({len(payload)}B)")
+        rid, idx, blen, crc = _PAGE.unpack_from(payload)
+        data = payload[_PAGE.size:]
+        if len(data) != blen:
+            raise TransportError(
+                f"torn page {idx} for request {rid}: header says {blen}B, "
+                f"frame carries {len(data)}B",
+                request_id=rid, page_index=idx)
+        if zlib.crc32(data) != crc:
+            raise TransportError(
+                f"page {idx} for request {rid} failed crc32",
+                request_id=rid, page_index=idx)
+        return ("page", rid, idx, data)
+
+
+def read_frame(sock: socket.socket, buf: FrameBuffer,
+               timeout_s: float) -> tuple:
+    """Blocking read of exactly ONE frame with a bounded wall deadline.
+    A stalled peer surfaces as `TransportError` ('stalled') instead of a
+    hang, and a peer that closes mid-frame as `TransportError` ('torn')
+    instead of a silent short read — the per-page timeout the KV-handoff
+    splice path relies on.  Bytes past the first frame stay in `buf`."""
+    deadline = monotonic() + max(1e-3, float(timeout_s))
+    while True:
+        try:
+            for frame in buf.frames():
+                return frame
+        except TransportError:
+            raise
+        remaining = deadline - monotonic()
+        if remaining <= 0:
+            raise TransportError(
+                f"frame read stalled: no complete frame within "
+                f"{timeout_s:.3f}s ({buf.pending()}B buffered)")
+        sock.settimeout(remaining)
+        try:
+            data = sock.recv(1 << 16)
+        except socket.timeout:
+            continue
+        except OSError as e:
+            raise TransportError(f"peer failed mid-frame: {e}") from e
+        if not data:
+            raise TransportError(
+                f"torn frame: peer closed with {buf.pending()}B of an "
+                f"incomplete frame buffered")
+        buf.feed(data)
 
 
 def listen(host: str = "127.0.0.1") -> tuple[socket.socket, int]:

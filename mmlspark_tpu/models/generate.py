@@ -829,6 +829,107 @@ def _merge_cache_rows_jit(dst_caches, src_caches, di, si):
     return merged
 
 
+_PAGE_LAYOUT = None  # lazy structs for the KV-page wire layout
+
+
+def _page_structs():
+    global _PAGE_LAYOUT
+    if _PAGE_LAYOUT is None:
+        import struct
+        # page header (n_layers, n_tensors); per-tensor header
+        # (dtype-name length, ndim); dims and byte lengths as >I
+        _PAGE_LAYOUT = (struct.Struct(">HH"), struct.Struct(">BB"),
+                        struct.Struct(">I"))
+    return _PAGE_LAYOUT
+
+
+def _wire_dtype(name: str) -> np.dtype:
+    """Resolve a serialized dtype name, including the ml_dtypes extras
+    (bfloat16 is the default model dtype and has no native numpy name —
+    np.save would silently degrade it to a void dtype)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except (AttributeError, TypeError) as e:
+            raise ValueError(f"unknown page tensor dtype {name!r}") from e
+
+
+def serialize_cache_row(caches, row: int, chunk: int) -> list:
+    """Cut ONE row of a serve cache into chunk-granular window pages for
+    the prefill->decode handoff: each page is a self-describing blob
+    (dtype name + shape + raw bytes per layer-tensor window slice) that
+    `deserialize_cache_row` reassembles without any side-channel layout
+    info.  Works for both cache layouts — the 2-tuple model-dtype (k, v)
+    and the 4-tuple int8 (kq, k_scale, vq, v_scale); int8 pages
+    naturally shrink the wire bytes, which is the point of quantizing
+    BEFORE shipping.  The explicit dtype name (not npy) is what keeps
+    bfloat16 byte-exact across the wire."""
+    import io
+    page_hdr, tens_hdr, u32 = _page_structs()
+    host = [[np.asarray(t[row]) for t in layer] for layer in caches]
+    width = host[0][0].shape[0]
+    pages = []
+    for lo in range(0, width, max(1, int(chunk))):
+        hi = min(width, lo + max(1, int(chunk)))
+        bio = io.BytesIO()
+        bio.write(page_hdr.pack(len(host), len(host[0])))
+        for layer in host:
+            for tensor in layer:
+                part = np.ascontiguousarray(tensor[lo:hi])
+                name = part.dtype.name.encode("ascii")
+                bio.write(tens_hdr.pack(len(name), part.ndim))
+                bio.write(name)
+                for dim in part.shape:
+                    bio.write(u32.pack(dim))
+                raw = part.tobytes()
+                bio.write(u32.pack(len(raw)))
+                bio.write(raw)
+        pages.append(bio.getvalue())
+    return pages
+
+
+def deserialize_cache_row(pages: list) -> list:
+    """Reassemble `serialize_cache_row` pages (in chunk order) into a
+    1-row cache ready for `DecodeEngine.merge_cache_rows` — window
+    slices concatenate back on the window axis and gain the batch dim.
+    Byte-exact: dtype and bits round-trip untouched."""
+    import io
+    if not pages:
+        raise ValueError("cannot deserialize an empty page list")
+    page_hdr, tens_hdr, u32 = _page_structs()
+
+    def read(bio, n):
+        data = bio.read(n)
+        if len(data) != n:
+            raise ValueError("short page: truncated tensor record")
+        return data
+
+    parts = None
+    for blob in pages:
+        bio = io.BytesIO(blob)
+        n_layers, n_tensors = page_hdr.unpack(read(bio, page_hdr.size))
+        if parts is None:
+            parts = [[[] for _ in range(n_tensors)]
+                     for _ in range(n_layers)]
+        elif len(parts) != n_layers or len(parts[0]) != n_tensors:
+            raise ValueError("page layout mismatch across pages")
+        for li in range(n_layers):
+            for ti in range(n_tensors):
+                nlen, ndim = tens_hdr.unpack(read(bio, tens_hdr.size))
+                dtype = _wire_dtype(read(bio, nlen).decode("ascii"))
+                shape = tuple(u32.unpack(read(bio, u32.size))[0]
+                              for _ in range(ndim))
+                (nbytes,) = u32.unpack(read(bio, u32.size))
+                arr = np.frombuffer(read(bio, nbytes), dtype=dtype)
+                parts[li][ti].append(arr.reshape(shape))
+    return [tuple(jnp.asarray(np.concatenate(tensors, axis=0))[None]
+                  for tensors in layer)
+            for layer in parts]
+
+
 class DecodeEngine:
     """Bucketed, cache-windowed, early-exit generation for one sampling
     configuration (the module docstring has the design).

@@ -100,6 +100,10 @@ class RunTelemetry:
         # failover, ejection, probe re-admission — what the router drills
         # assert their failover/ejection sequences against
         self._routing: list[dict] = []
+        # the run's KV-handoff timeline (serve/handoff.py): transfer
+        # begin/page/splice/fail events in order — what the disagg drill
+        # asserts its re-prefill and cancel-at-splice invariants against
+        self._handoff: list[dict] = []
         # the run's data-service timeline (data/service/dispatcher.py):
         # split dispatch/completion, worker death, re-dispatch, scaling —
         # what the data drill asserts its recovery invariants against
@@ -239,6 +243,19 @@ class RunTelemetry:
         self.tracer._record({"type": "routing",
                              "ts": round(self.tracer.now(), 6), **rec})
 
+    def record_handoff(self, event: dict) -> None:
+        """Append one KV-handoff event (serve/handoff.py) to the run's
+        ordered timeline (also streamed as a `handoff` record); the full
+        list lands in run_summary.json under `handoff` — every transfer
+        begin, page rejection, splice, cancel-at-splice, and re-prefill,
+        machine-readable for the disagg drill."""
+        if not self.live:
+            return
+        rec = dict(event)
+        self._handoff.append(rec)
+        self.tracer._record({"type": "handoff",
+                             "ts": round(self.tracer.now(), 6), **rec})
+
     def record_data_service(self, event: dict) -> None:
         """Append one data-service event (data/service/dispatcher.py) to
         the run's ordered timeline (also streamed as a `data_service`
@@ -289,6 +306,7 @@ class RunTelemetry:
             "recovery": [dict(e) for e in self._recovery],
             "serve": [dict(e) for e in self._serve],
             "routing": [dict(e) for e in self._routing],
+            "handoff": [dict(e) for e in self._handoff],
             "data_service": [dict(e) for e in self._data_service],
             "trace_records_dropped": self.tracer.dropped,
         }

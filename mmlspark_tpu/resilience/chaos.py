@@ -142,6 +142,17 @@ class Fault:
     dispatcher must re-dispatch it with no duplicated or dropped rows),
     'worker_slow' (the worker's production throttled by `factor` — the
     stall-evidence autoscaling drill).
+
+    KV-handoff faults (interpreted by the handoff bus itself,
+    serve/handoff.py, during the disagg drill scripts/disagg_drill.py):
+    they fire on the `at_request`-th KV transfer (1-based) —
+    'handoff_torn' (one page frame is bit-flipped on the wire; the
+    decode side must reject it on crc32 and the request re-prefills
+    byte-exact), 'handoff_stall' (the sender withholds pages for
+    `seconds` VIRTUAL seconds; the per-transfer deadline must fire and
+    re-queue the request), 'prefill_crash_mid_transfer' (the prefill
+    replica crashes after its first page is on the wire; the remaining
+    pages never arrive and the request must re-prefill elsewhere).
     """
 
     kind: str
@@ -162,11 +173,14 @@ class Fault:
               "burst", "slow_client", "poison",
               "replica_crash", "replica_hang", "replica_flap",
               "replica_slow",
-              "worker_crash", "worker_slow")
+              "worker_crash", "worker_slow",
+              "handoff_torn", "handoff_stall", "prefill_crash_mid_transfer")
     _SERVE_KINDS = ("burst", "slow_client", "poison")
     _REPLICA_KINDS = ("replica_crash", "replica_hang", "replica_flap",
                       "replica_slow")
     _DATA_KINDS = ("worker_crash", "worker_slow")
+    _HANDOFF_KINDS = ("handoff_torn", "handoff_stall",
+                      "prefill_crash_mid_transfer")
     _TARGETS = ("payload", "sidecar", "latest")
 
     def __post_init__(self):
@@ -371,6 +385,24 @@ class ChaosInjector:
                 inc_counter(f"chaos.{f.kind}")
                 trace_event(f"chaos.{f.kind}", cat="resilience",
                             request_index=request_index, replica=f.replica)
+                due.append(f)
+        return due
+
+    def handoff_faults_due(self, transfer_index: int) -> list:
+        """The unfired scripted KV-HANDOFF faults due at `transfer_index`
+        (1-based count of KV transfers begun), each fired at most once.
+        The handoff bus (serve/handoff.py) consults this as it opens each
+        transfer and acts the fault out on the wire (bit-flip a page /
+        withhold pages / crash the sending replica) — the receiving side
+        and the router only ever see the resulting damage."""
+        due = []
+        for i, f in enumerate(self.script):
+            if f.kind in Fault._HANDOFF_KINDS and i not in self._fired \
+                    and transfer_index >= f.at_request:
+                self._fired.add(i)
+                inc_counter(f"chaos.{f.kind}")
+                trace_event(f"chaos.{f.kind}", cat="resilience",
+                            transfer_index=transfer_index)
                 due.append(f)
         return due
 

@@ -19,6 +19,11 @@ ROADMAP's "millions of users" north star needs:
     behind a health-aware routing front tier (power-of-two-choices,
     outlier ejection with half-open probe re-admission, failover under
     a retry budget, optional hedging);
+  * `handoff` — the disaggregated-tier KV handoff bus: prefill
+    replicas ship finished (int8-capable) cache rows as crc-checked
+    chunk pages over transport frames to the decode tier, with
+    acks, watchdogs, and re-prefill failover (docs/serving.md
+    "Disaggregated tiers");
   * `http` — stdlib-only request front end + health endpoints
     (`/healthz`, `/readyz`, POST `/generate` with optional chunked
     token streaming), next to `observe/export.serve_metrics`.
@@ -30,6 +35,7 @@ from mmlspark_tpu.serve.admission import (AdmissionController,
                                           InvalidRequest, MissRateBreaker,
                                           Overloaded, StepTimeEstimator)
 from mmlspark_tpu.serve.engine import ServeConfig, ServingEngine
+from mmlspark_tpu.serve.handoff import HandoffBus
 from mmlspark_tpu.serve.lifecycle import (serve_forever, start_engine,
                                           start_http, start_router)
 from mmlspark_tpu.serve.replica import Replica, ReplicaUnavailable
@@ -38,7 +44,8 @@ from mmlspark_tpu.serve.router import (RetryBudget, Router, RouterConfig,
                                        RouterRequest, build_fleet)
 
 __all__ = [
-    "AdmissionController", "InvalidRequest", "MissRateBreaker",
+    "AdmissionController", "HandoffBus", "InvalidRequest",
+    "MissRateBreaker",
     "Overloaded", "Replica", "ReplicaUnavailable", "Request",
     "RetryBudget", "Router", "RouterConfig", "RouterRequest",
     "ServeConfig", "ServingEngine", "StepTimeEstimator", "build_fleet",

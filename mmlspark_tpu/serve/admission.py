@@ -83,6 +83,7 @@ class StepTimeEstimator:
         self.alpha = float(alpha)
         self._prefill: dict[int, float] = {}   # bucket -> seconds
         self._step: dict[int, float] = {}      # bucket -> seconds / step
+        self._handoff: dict[int, float] = {}   # bucket -> transfer seconds
         self._lock = threading.Lock()
 
     def _fold(self, table: dict, bucket: int, value: float) -> None:
@@ -96,6 +97,13 @@ class StepTimeEstimator:
 
     def observe_step(self, bucket: int, seconds_per_step: float) -> None:
         self._fold(self._step, bucket, max(0.0, float(seconds_per_step)))
+
+    def observe_handoff(self, bucket: int, seconds: float) -> None:
+        """Disaggregated fleets: the measured prefill->decode KV transfer
+        wall per bucket — a third priced stage, so admission feasibility
+        on a tiered fleet includes the wire time between the tiers
+        instead of pretending the cache teleports."""
+        self._fold(self._handoff, bucket, max(0.0, float(seconds)))
 
     def _lookup(self, table: dict, bucket: int) -> Optional[float]:
         with self._lock:
@@ -111,18 +119,21 @@ class StepTimeEstimator:
         return self._lookup(self._step, bucket)
 
     def service_s(self, bucket: int, n_tokens: int) -> Optional[float]:
-        """Estimated engine-occupancy seconds for one request, or None
-        with no evidence yet."""
+        """Estimated engine-occupancy seconds for one request — prefill
+        stage + handoff stage (tiered fleets; 0 until observed) + decode
+        steps — or None with no evidence yet."""
         step = self._lookup(self._step, bucket)
         if step is None:
             return None
         prefill = self._lookup(self._prefill, bucket) or 0.0
-        return prefill + step * max(1, int(n_tokens))
+        handoff = self._lookup(self._handoff, bucket) or 0.0
+        return prefill + handoff + step * max(1, int(n_tokens))
 
     def snapshot(self) -> dict:
         with self._lock:
             return {"prefill_s": dict(self._prefill),
-                    "step_s": dict(self._step)}
+                    "step_s": dict(self._step),
+                    "handoff_s": dict(self._handoff)}
 
 
 class MissRateBreaker:

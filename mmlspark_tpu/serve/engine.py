@@ -57,7 +57,8 @@ from mmlspark_tpu.resilience.clock import Clock, get_clock
 from mmlspark_tpu.serve.admission import (AdmissionController,
                                           InvalidRequest, MissRateBreaker,
                                           Overloaded, StepTimeEstimator)
-from mmlspark_tpu.serve.request import (CANCELLED, OK, TIMEOUT, Request)
+from mmlspark_tpu.serve.request import (CANCELLED, HANDOFF, OK, TIMEOUT,
+                                        Request)
 
 SERVE_QUEUE_CAPACITY = config.register(
     "MMLSPARK_TPU_SERVE_QUEUE_CAPACITY", 64,
@@ -100,6 +101,20 @@ SERVE_SPEC_TOKENS = config.register(
     "Greedy outputs stay byte-identical to plain decoding; a round "
     "advances a row by up to this+1 tokens for one target forward",
     ptype=int)
+SERVE_ROLE = config.register(
+    "MMLSPARK_TPU_SERVE_ROLE", "colocated",
+    "serving: this engine's tier in a disaggregated fleet — 'colocated' "
+    "(prefill + decode on the same replica, the default), 'prefill' "
+    "(runs chunked prefill only, ships finished KV cache rows to a "
+    "decode replica over the handoff bus), or 'decode' (receives "
+    "handed-off rows and decodes them to completion)", ptype=str)
+SERVE_CACHE_DTYPE = config.register(
+    "MMLSPARK_TPU_SERVE_CACHE_DTYPE", "model",
+    "serving: resident KV-cache dtype — 'model' or 'int8' (per-head "
+    "symmetric quantize-on-write; on a disaggregated fleet int8 pages "
+    "also halve the handoff wire bytes)", ptype=str)
+
+_ROLES = ("colocated", "prefill", "decode")
 
 
 @dataclasses.dataclass
@@ -130,11 +145,15 @@ class ServeConfig:
     warmup_joins: Optional[bool] = None  # pre-compile late-join shapes too
     prefill_chunk: Optional[int] = None  # chunked prefill (0 = off)
     spec_tokens: Optional[int] = None    # speculative draft depth (0 = off)
+    role: Optional[str] = None           # colocated | prefill | decode
+    cache_dtype: Optional[str] = None    # model | int8 resident KV cache
 
     def __post_init__(self):
         read = lambda explicit, var, cast: cast(
             var.current() if explicit is None else explicit)
         self.max_batch = read(self.max_batch, SERVE_MAX_BATCH, int)
+        self.role = read(self.role, SERVE_ROLE, str)
+        self.cache_dtype = read(self.cache_dtype, SERVE_CACHE_DTYPE, str)
         self.queue_capacity = read(self.queue_capacity,
                                    SERVE_QUEUE_CAPACITY, int)
         self.segment_steps = read(self.segment_steps,
@@ -152,6 +171,19 @@ class ServeConfig:
             raise ValueError("prefill_chunk must be >= 0")
         if self.spec_tokens < 0:
             raise ValueError("spec_tokens must be >= 0")
+        if self.role not in _ROLES:
+            raise ValueError(f"role must be one of {_ROLES}, "
+                             f"got {self.role!r}")
+        if self.cache_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"cache_dtype must be 'model' or 'int8', "
+                f"got {self.cache_dtype!r}")
+        if self.role != "colocated" and self.spec_tokens:
+            # the handoff carries target caches only; speculative lanes
+            # would need the draft cache shipped too — out of scope
+            raise ValueError(
+                "speculative decoding is colocated-only: a "
+                f"role={self.role!r} tier cannot run spec_tokens > 0")
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if self.segment_steps < 1:
@@ -262,6 +294,12 @@ class ServingEngine:
         # in-flight chunked prefills: one advances a single chunk per
         # tick, between phase 4 (joins) and phase 5 (segments)
         self._pending: list[dict] = []
+        self.role = self.cfg.role
+        # prefill tier: the handoff bus (serve/handoff.py) wires this to
+        # receive each finished cohort's (reqs, first tokens, caches)
+        # instead of seating them locally; the engine finishes the
+        # exported requests with status `handoff`
+        self.handoff_export = None
         self._state = CREATED
         self._state_lock = threading.Lock()
         self._wake = threading.Condition()
@@ -290,6 +328,7 @@ class ServingEngine:
             temperature=self.cfg.temperature, top_k=self.cfg.top_k,
             top_p=self.cfg.top_p, stop_tokens=self.cfg.stop_tokens,
             chunk=self.cfg.cache_chunk, mesh=self._mesh,
+            cache_dtype=self.cfg.cache_dtype,
             prefill_chunk=self.cfg.prefill_chunk or None,
             draft_module=self._draft_module,
             spec_tokens=self.cfg.spec_tokens)
@@ -400,6 +439,10 @@ class ServingEngine:
             if n >= cap:
                 break
             n *= 2
+        if self.role == "prefill":
+            # a prefill-tier engine never decodes or merges: the cohort
+            # prefill programs above are its whole compiled surface
+            return
         warmed_widths: set = set()
 
         def warm_joins(resident) -> None:
@@ -857,11 +900,28 @@ class ServingEngine:
     def _splice(self, g: _Group, lane: str, reqs: list, slots: list,
                 src: list, tok_h, caches, prompts) -> None:
         """Merge cohort cache rows (and, on speculative lanes, the
-        cohort's draft cache rows) into the group and seat the requests."""
+        cohort's draft cache rows) into the group and seat the requests.
+
+        On a PREFILL-tier engine this is where the work leaves: the
+        finished cohort's caches go to the handoff bus instead of a
+        resident slot, and each engine request ends `handoff` — the
+        router's fleet request stays open until a decode replica splices
+        the shipped rows and finishes the decode attempt."""
         eng = self._engines[lane]
+        if self.role == "prefill" and self.handoff_export is not None:
+            now = self.now()
+            self.handoff_export(bucket=g.bucket, lane=lane, reqs=reqs,
+                                src=src, tok_h=tok_h, caches=caches)
+            for req in reqs:
+                self._count("handoffs")
+                trace_event("serve.handoff_out", cat="serve",
+                            request=req.id, bucket=g.bucket, lane=lane)
+                req.finish(HANDOFF, now)
+            return
         if g.caches is None:
             g.caches = self._empty_caches(eng.module, g.capacity,
-                                          g.bucket)
+                                          g.bucket,
+                                          kind=eng.cache_dtype)
         g.caches = DecodeEngine.merge_cache_rows(
             g.caches, caches, slots, src, mesh=eng.mesh)
         if eng.spec_tokens:
@@ -886,14 +946,69 @@ class ServingEngine:
                                 "lane": lane})
             self._emit(g, slot, [int(tok_h[j])])
 
-    def _empty_caches(self, module, capacity: int, bucket: int) -> list:
+    def _empty_caches(self, module, capacity: int, bucket: int,
+                      kind: str = "model") -> list:
         import jax.numpy as jnp
         dh = module.d_model // module.n_heads
         w0 = _round_up(bucket + 1, self.cfg.cache_chunk)
         shape = (capacity, w0, module.n_heads, dh)
+        if kind == "int8":
+            # the quantized layout: int8 payloads + f32 per-(row, slot,
+            # head) scales, matching _quantize_cache's 4-tuple
+            sshape = (capacity, w0, module.n_heads)
+            return [(jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(sshape, jnp.float32),
+                     jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(sshape, jnp.float32))
+                    for _ in range(module.n_layers)]
         return [(jnp.zeros(shape, module.dtype),
                  jnp.zeros(shape, module.dtype))
                 for _ in range(module.n_layers)]
+
+    def splice_remote(self, prompt: np.ndarray, max_new_tokens: int,
+                      deadline: float, first_tok: int, src_caches,
+                      lane: str = "primary") -> Optional[Request]:
+        """Seat one handed-off row (decode tier): merge the deserialized
+        1-row cache into this engine's resident batch via the jitted
+        `merge_cache_rows` and decode it to completion like any join.
+        Returns the seated engine Request, or None when no slot is free
+        or the engine is not alive — the handoff bus retries next tick
+        (bounded by the transfer timeout and the request deadline)."""
+        if not self.alive:
+            return None
+        eng = self._engines[lane]
+        arr = np.asarray(prompt, np.int32)
+        bucket = eng.bucket_for(arr.size)
+        g = self._groups.get((bucket, lane))
+        if g is None:
+            g = self._groups[(bucket, lane)] = _Group(
+                bucket, self.cfg.max_batch)
+        free = g.free_slots()
+        if not free:
+            return None
+        slot = free[0]
+        now = self.now()
+        req = Request(self._new_id(), arr, bucket, max_new_tokens, now,
+                      float(deadline))
+        if g.caches is None:
+            g.caches = self._empty_caches(eng.module, g.capacity, bucket,
+                                          kind=eng.cache_dtype)
+        g.caches = DecodeEngine.merge_cache_rows(
+            g.caches, src_caches, [slot], [0], mesh=eng.mesh)
+        g.rows[slot] = req
+        g.tok[slot] = int(first_tok)
+        g.true_len[slot] = req.true_len
+        g.budget[slot] = req.max_new_tokens
+        g.t_row[slot] = 0
+        g.row_ids[slot] = req.id
+        g.done[slot] = False
+        self._count("remote_joins")
+        trace_event("serve.handoff_in", cat="serve", request=req.id,
+                    bucket=bucket, slot=slot, lane=lane)
+        self._record_serve({"event": "remote_join", "request": req.id,
+                            "bucket": bucket, "slot": slot, "lane": lane})
+        self._emit(g, slot, [int(first_tok)])
+        return req
 
     def _emit(self, g: _Group, slot: int, tokens: list) -> None:
         """Append emitted tokens to a row's request, honoring its budget

@@ -97,11 +97,15 @@ def make_handler(engine):
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
             path = self.path.split("?")[0]
             if path == "/healthz":
+                role = getattr(engine, "role", None) or (
+                    "tiered" if getattr(engine, "tiered", False) else None)
+                body = {"status": "ok", "state": engine.state}
+                if role:
+                    body["role"] = role
                 if engine.state == "stopped":
                     self._json(503, {"status": "stopped"})
                 else:
-                    self._json(200, {"status": "ok",
-                                     "state": engine.state})
+                    self._json(200, body)
             elif path == "/readyz":
                 if engine.ready:
                     self._json(200, {"ready": True})

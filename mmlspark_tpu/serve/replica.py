@@ -77,12 +77,19 @@ class Replica:
                  probe_reset_s: float = 5.0, miss_alpha: float = 0.2):
         self.name = name
         self.engine = engine
+        self.role = engine.cfg.role
         self._clock = clock
         # the ejection gate: consecutive attempt failures open it; the
-        # half-open probe is a real routed request
+        # half-open probe is a real routed request.  Disaggregated tiers
+        # get their own breaker keying (`serve.prefill.p0`,
+        # `serve.decode.d0`) so per-tier ejection state is separable in
+        # /metrics; colocated replicas keep the PR-10 key.
+        key = (f"serve.{self.role}.{name}" if self.role != "colocated"
+               else f"serve.replica.{name}")
         self.breaker = register_breaker(CircuitBreaker(
-            f"serve.replica.{name}", threshold=max(1, int(eject_failures)),
+            key, threshold=max(1, int(eject_failures)),
             reset_s=float(probe_reset_s), clock=clock))
+        self.draining = False          # per-replica SIGTERM drain flag
         self.miss_alpha = float(miss_alpha)
         self.miss_ewma = 0.0
         self.miss_samples = 0
@@ -135,7 +142,7 @@ class Replica:
         """May receive NORMAL traffic: engine ready, handle healthy, and
         the ejection breaker closed.  A slow replica stays routable —
         ejection needs evidence (misses), not suspicion."""
-        return (not self._crashed and not self._hung
+        return (not self._crashed and not self._hung and not self.draining
                 and self.engine.ready and self.breaker.state == CLOSED)
 
     def probe_due(self) -> bool:
@@ -144,7 +151,20 @@ class Replica:
         gate.  A still-dead replica fails its probe and restarts the
         cooldown — the probe IS the health check."""
         return (self.breaker.state == OPEN and self.breaker.retry_in_s() <= 0
-                and self.probe is None)
+                and self.probe is None and not self.draining)
+
+    def begin_drain(self, reason: str = "sigterm") -> None:
+        """Per-replica SIGTERM: stop taking new traffic and drain by the
+        TIER's semantics.  A prefill replica finishes its queued and
+        in-flight prefills — and, via the router, its in-flight KV
+        transfers — before stopping; a decode replica finishes or
+        cancels its resident rows under the engine's drain budget.  The
+        router's drain-finalize phase stops the engine once it (and, for
+        prefill, the handoff bus) is empty."""
+        if self.draining or not self.engine.alive:
+            return
+        self.draining = True
+        self.engine.begin_drain(f"replica_drain:{reason}")
 
     def observe_completion(self, missed: bool) -> float:
         """Fold one attempt outcome into the deadline-miss EWMA; returns
@@ -205,6 +225,14 @@ class Replica:
                 g.rows[i].finish(ERROR, now, detail)
                 g.release(i)
                 failed += 1
+        # mid-chunked-prefill cohorts hold only RESERVED slots — their
+        # requests live in the pending-job list, not in any row
+        for job in list(self.engine._pending):
+            for req in job["reqs"]:
+                if not req.finished:
+                    req.finish(ERROR, now, detail)
+                    failed += 1
+        self.engine._pending.clear()
         self.engine._groups.clear()
         for req in self.engine.admission.drop_expired(float("inf")):
             req.finish(ERROR, now, detail)
@@ -272,6 +300,8 @@ class Replica:
         """Point-in-time health for /statz, gauges, and the drills."""
         return {"state": self.engine.state,
                 "ready": self.engine.ready,
+                "role": self.role,
+                "draining": self.draining,
                 "routable": self.routable(),
                 "breaker": self.breaker.snapshot(),
                 "miss_ewma": round(self.miss_ewma, 4),

@@ -13,6 +13,8 @@ statuses:
                finished too late to count
     cancelled  the engine drained (SIGTERM / stop) before it could finish
     error      an internal failure; `detail` carries the reason
+    handoff    prefill-tier engines only: the finished KV cache shipped
+               to a decode replica (the fleet request is still live)
 
 Deadlines are ABSOLUTE times on the resilience clock
 (`resilience.clock.get_clock().monotonic()`), so every piece of deadline
@@ -32,6 +34,10 @@ import numpy as np
 
 # terminal statuses
 OK, TIMEOUT, CANCELLED, ERROR = "ok", "timeout", "cancelled", "error"
+# terminal FOR THE PREFILL-TIER ENGINE only: the request's KV cache left
+# for a decode replica over the handoff bus; the router's request stays
+# open until the decode attempt finishes (serve/handoff.py owns it)
+HANDOFF = "handoff"
 
 
 class Request:

@@ -65,8 +65,9 @@ from mmlspark_tpu.serve.engine import (CREATED, DRAINING, READY, STOPPED,
                                        SERVE_DRAIN_TIMEOUT_S,
                                        SERVE_QUEUE_CAPACITY, ServeConfig,
                                        ServingEngine)
+from mmlspark_tpu.serve.handoff import HandoffBus
 from mmlspark_tpu.serve.replica import Replica, ReplicaUnavailable
-from mmlspark_tpu.serve.request import CANCELLED, OK, TIMEOUT
+from mmlspark_tpu.serve.request import CANCELLED, HANDOFF, OK, TIMEOUT
 
 SERVE_REPLICAS = config.register(
     "MMLSPARK_TPU_SERVE_REPLICAS", 2,
@@ -102,6 +103,24 @@ SERVE_HEDGE_FRACTION = config.register(
     "serving fleet: hedge a request onto a second replica when its "
     "remaining deadline < fraction x estimated service time "
     "(0 disables hedging)", ptype=float)
+SERVE_PREFILL_REPLICAS = config.register(
+    "MMLSPARK_TPU_SERVE_PREFILL_REPLICAS", 0,
+    "disaggregated fleet: prefill-tier replicas (0 = colocated fleet; "
+    "set together with MMLSPARK_TPU_SERVE_DECODE_REPLICAS)", ptype=int)
+SERVE_DECODE_REPLICAS = config.register(
+    "MMLSPARK_TPU_SERVE_DECODE_REPLICAS", 0,
+    "disaggregated fleet: decode-tier replicas (0 = colocated fleet)",
+    ptype=int)
+SERVE_HANDOFF_TIMEOUT_S = config.register(
+    "MMLSPARK_TPU_SERVE_HANDOFF_TIMEOUT_S", 10.0,
+    "disaggregated fleet: a KV transfer with no page/ack movement for "
+    "this long (virtual seconds) is failed and the request re-prefills "
+    "elsewhere", ptype=float)
+SERVE_HANDOFF_PAGES_PER_TICK = config.register(
+    "MMLSPARK_TPU_SERVE_HANDOFF_PAGES_PER_TICK", 4,
+    "disaggregated fleet: KV pages pushed per transfer per router tick "
+    "— the pipelining knob that overlaps transfer with prefill compute",
+    ptype=int)
 
 # the router-only terminal status: a failed request the retry budget
 # would not let us place again (HTTP 429 + Retry-After)
@@ -129,6 +148,13 @@ class RouterConfig:
     hedge_fraction: Optional[float] = None
     miss_alpha: float = 0.2
     seed: int = 0
+    # disaggregated tiers (docs/serving.md 'Disaggregated tiers'): both
+    # counts > 0 makes build_fleet construct role=prefill/decode pools
+    # with the KV handoff bus between them
+    prefill_replicas: Optional[int] = None
+    decode_replicas: Optional[int] = None
+    handoff_timeout_s: Optional[float] = None
+    handoff_pages_per_tick: Optional[int] = None
 
     def __post_init__(self):
         read = lambda explicit, var, cast: cast(
@@ -154,6 +180,25 @@ class RouterConfig:
                                    SERVE_HANG_TIMEOUT_S, float)
         self.hedge_fraction = read(self.hedge_fraction,
                                    SERVE_HEDGE_FRACTION, float)
+        self.prefill_replicas = read(self.prefill_replicas,
+                                     SERVE_PREFILL_REPLICAS, int)
+        self.decode_replicas = read(self.decode_replicas,
+                                    SERVE_DECODE_REPLICAS, int)
+        self.handoff_timeout_s = read(self.handoff_timeout_s,
+                                      SERVE_HANDOFF_TIMEOUT_S, float)
+        self.handoff_pages_per_tick = read(self.handoff_pages_per_tick,
+                                           SERVE_HANDOFF_PAGES_PER_TICK,
+                                           int)
+        if (self.prefill_replicas > 0) != (self.decode_replicas > 0):
+            raise ValueError(
+                "a disaggregated fleet needs BOTH prefill_replicas and "
+                "decode_replicas > 0 (or both 0 for colocated)")
+        if self.prefill_replicas < 0 or self.decode_replicas < 0:
+            raise ValueError("tier replica counts must be >= 0")
+        if self.handoff_timeout_s <= 0:
+            raise ValueError("handoff_timeout_s must be > 0")
+        if self.handoff_pages_per_tick < 1:
+            raise ValueError("handoff_pages_per_tick must be >= 1")
         if self.replicas < 1:
             raise ValueError("replicas must be >= 1")
         if self.retry_budget_cap < 0:
@@ -326,6 +371,21 @@ class Router:
         self._by_name = {r.name: r for r in self.replicas}
         if len(self._by_name) != len(self.replicas):
             raise ValueError("replica names must be unique")
+        # disaggregated tiers: replica roles partition the fleet; a
+        # tiered fleet dispatches to the PREFILL tier only and the
+        # handoff bus moves finished KV rows to the decode tier
+        self._prefill_reps = [r for r in self.replicas
+                              if r.role == "prefill"]
+        self._decode_reps = [r for r in self.replicas
+                             if r.role == "decode"]
+        if self._prefill_reps or self._decode_reps:
+            colocated = [r for r in self.replicas
+                         if r.role not in ("prefill", "decode")]
+            if not self._prefill_reps or not self._decode_reps or colocated:
+                raise ValueError(
+                    "a disaggregated fleet needs at least one prefill and "
+                    "one decode replica, and no colocated ones")
+        self.tiered = bool(self._prefill_reps)
         # the fleet estimator: every replica's measured prefill/segment
         # walls tee into it, so admission feasibility reflects real
         # decode speed no matter which replica produced the evidence
@@ -352,6 +412,14 @@ class Router:
         self._thread = None            # set by lifecycle.start_router
         self._guard = None             # PreemptionGuard, set by lifecycle
         self._run = active_run()
+        self.handoff: Optional[HandoffBus] = None
+        if self.tiered:
+            self.handoff = HandoffBus(
+                self, timeout_s=self.cfg.handoff_timeout_s,
+                pages_per_tick=self.cfg.handoff_pages_per_tick)
+            for rep in self._prefill_reps:
+                rep.engine.handoff_export = self.handoff.make_export(
+                    rep.name)
 
     # -- lifecycle ---------------------------------------------------------
     def now(self) -> float:
@@ -406,6 +474,8 @@ class Router:
             except Exception as e:
                 get_logger("serve").warning(
                     "replica %s failed to stop cleanly: %r", r.name, e)
+        if self.handoff is not None:
+            self.handoff.close()
         self._state = STOPPED
         self._record_routing("drain_end", counts=dict(self._counts))
         self._gauge_fleet()
@@ -477,7 +547,12 @@ class Router:
         deadline = now + (float(deadline_s) if deadline_s is not None
                           else self.cfg.default_deadline_s)
         rr = RouterRequest(self._new_id(), arr, bucket, n_new, now, deadline)
-        if not any(r.routable() or r.probe_due() for r in self.replicas):
+        # a tiered fleet needs BOTH tiers reachable: prefill to take the
+        # dispatch, decode to take the handoff
+        pools = ([self._prefill_reps, self._decode_reps] if self.tiered
+                 else [self.replicas])
+        if not all(any(r.routable() or r.probe_due() for r in pool)
+                   for pool in pools):
             self._count("shed_no_replica")
             self._count("shed")
             self._record_routing("shed", reason="no_replica", request=rr.id)
@@ -580,11 +655,12 @@ class Router:
         """Dispatch preference: a due probe first (re-admission must not
         starve behind healthy capacity), then the p2c pick, then the
         remaining routable replicas by load."""
+        pool = self._prefill_reps if self.tiered else self.replicas
         order: list[Replica] = []
-        probes = [r for r in self.replicas if r.probe_due()]
+        probes = [r for r in pool if r.probe_due()]
         if probes:
             order.append(probes[0])
-        healthy = [r for r in self.replicas if r.routable()]
+        healthy = [r for r in pool if r.routable()]
         if len(healthy) >= 2:
             a, b = self._rng.sample(healthy, 2)
             pick = min((a, b), key=lambda r: r.load_tokens())
@@ -655,6 +731,31 @@ class Router:
         return progressed
 
     # -- harvest / failover ------------------------------------------------
+    def _rr_for_attempt(self, att) -> Optional[RouterRequest]:
+        """The live fleet request owning one engine attempt (the handoff
+        bus resolves the exported engine request back to its router
+        request this way — engine requests carry no back-pointer)."""
+        for rr in list(self._live):
+            for _, a in rr.attempts:
+                if a is att:
+                    return rr
+        return None
+
+    def _handoff_failed(self, rr: RouterRequest, reason: str,
+                        now: float) -> None:
+        """A KV transfer died (torn page, stall, sender crash, no decode
+        capacity): the prefill work is lost, so the request re-prefills
+        elsewhere through the normal failover path — retry budget,
+        re-queue at the head, byte-exact final output."""
+        if rr.finished:
+            return
+        if rr in self._live:
+            self._live.remove(rr)
+        self._count("handoff_retries")
+        self._record_routing("handoff_failed", request=rr.id,
+                             reason=reason)
+        self._failover(rr, now)
+
     def _failover(self, rr: RouterRequest, now: float) -> None:
         if rr.deadline <= now:
             self._complete(rr, TIMEOUT, "deadline passed before failover")
@@ -717,6 +818,9 @@ class Router:
             if any(att.status is None for _, att in atts):
                 continue               # still running somewhere
             name, att = atts[-1]
+            if att.status == HANDOFF:
+                continue     # KV transfer in flight; the bus owns the
+                #              outcome (splice, cancel, or re-prefill)
             rep = self._by_name[name]
             if att.status == TIMEOUT:
                 if rep.probe is att:
@@ -741,7 +845,9 @@ class Router:
 
     # -- hedging -----------------------------------------------------------
     def _hedge(self, now: float) -> bool:
-        if self.cfg.hedge_fraction <= 0:
+        if self.cfg.hedge_fraction <= 0 or self.tiered:
+            # tiered fleets don't hedge: a duplicate prefill would also
+            # duplicate the KV transfer — failover handles loss instead
             return False
         progressed = False
         for rr in list(self._live):
@@ -823,6 +929,9 @@ class Router:
                         if not att.finished:
                             self._by_name[name].engine.cancel_request(
                                 att, "drain timeout")
+                    if self.handoff is not None and self.handoff.drop_for(rr):
+                        self._record_routing("cancel", request=rr.id,
+                                             reason="drain_timeout")
                     self._complete(rr, CANCELLED, "drain timeout")
                 self._live.remove(rr)
             for rr in self.admission.drop_expired(float("inf")):
@@ -832,11 +941,32 @@ class Router:
         # 4. place queued work on replicas (probe first, then p2c)
         worked |= self._dispatch(now)
         # 5. advance every replica one scheduler pass
+        prefill_worked = False
         for rep in self.replicas:
             if rep.tick():
                 worked = True
+                if rep.role == "prefill":
+                    prefill_worked = True
+        # 5b. pump the KV handoff bus: page pushes pipeline behind the
+        # prefill tier's compute (the overlap the bench arm reports)
+        if self.handoff is not None:
+            worked |= self.handoff.pump(now, compute_worked=prefill_worked)
         # 6. harvest attempt outcomes; fail over the dead ones
         worked |= self._harvest(now)
+        # 6b. per-replica SIGTERM drain: stop a draining replica's engine
+        # once its own queue, residents, and (prefill tier) in-flight KV
+        # transfers are empty — tier-correct drain semantics
+        for rep in self.replicas:
+            if rep.draining and rep.engine.state == DRAINING:
+                owed = (self.handoff.transfers_from(rep.name)
+                        if (self.handoff is not None
+                            and rep.role == "prefill") else 0)
+                if not rep.busy() and owed == 0:
+                    rep.engine._finish_drain()
+                    self._count("replica_drains")
+                    self._record_routing("replica_drained",
+                                         replica=rep.name, role=rep.role)
+                    worked = True
         # 7. deadline-aware hedging (off unless configured)
         worked |= self._hedge(now)
         # 8. drain completion
@@ -882,6 +1012,19 @@ class Router:
             p = self._percentile(q)
             out[f"latency_{name}_s"] = round(p, 6) if p is not None else None
         out["replicas"] = {r.name: r.health() for r in self.replicas}
+        if self.tiered:
+            def tier(reps):
+                return {"replicas": [r.name for r in reps],
+                        "routable": sum(1 for r in reps if r.routable()),
+                        "draining": sum(1 for r in reps if r.draining),
+                        "queued": sum(r.engine.admission.pending()
+                                      for r in reps),
+                        "in_flight": sum(r.engine.in_flight()
+                                         for r in reps),
+                        "load_tokens": sum(r.load_tokens() for r in reps)}
+            out["tiers"] = {"prefill": tier(self._prefill_reps),
+                            "decode": tier(self._decode_reps)}
+            out["handoff"] = self.handoff.stats()
         return out
 
     def _gauge_fleet(self) -> None:
@@ -910,13 +1053,28 @@ def build_fleet(bundle, n: Optional[int] = None, *,
     and the degraded fallback bundle; each gets its own engine, breaker,
     and health state."""
     cfg = cfg or RouterConfig()
-    count = int(n if n is not None else cfg.replicas)
-    replicas = []
-    for i in range(count):
-        engine = ServingEngine(bundle, serve_cfg or ServeConfig(),
+    scfg = serve_cfg or ServeConfig()
+
+    def make(name: str, role_cfg: ServeConfig) -> Replica:
+        engine = ServingEngine(bundle, role_cfg,
                                degraded_bundle=degraded_bundle, clock=clock)
-        replicas.append(Replica(
-            f"r{i}", engine, clock=clock,
-            eject_failures=cfg.eject_failures,
-            probe_reset_s=cfg.probe_reset_s, miss_alpha=cfg.miss_alpha))
+        return Replica(name, engine, clock=clock,
+                       eject_failures=cfg.eject_failures,
+                       probe_reset_s=cfg.probe_reset_s,
+                       miss_alpha=cfg.miss_alpha)
+
+    replicas = []
+    if cfg.prefill_replicas > 0:
+        # disaggregated tiers: prefill pool p0..pN hands finished KV
+        # rows over the bus to decode pool d0..dM
+        for i in range(cfg.prefill_replicas):
+            replicas.append(make(
+                f"p{i}", dataclasses.replace(scfg, role="prefill")))
+        for i in range(cfg.decode_replicas):
+            replicas.append(make(
+                f"d{i}", dataclasses.replace(scfg, role="decode")))
+    else:
+        count = int(n if n is not None else cfg.replicas)
+        for i in range(count):
+            replicas.append(make(f"r{i}", scfg))
     return Router(replicas, cfg, clock=clock)

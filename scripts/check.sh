@@ -46,6 +46,9 @@ python scripts/router_drill.py
 echo "== data drill (worker-crash redispatch / dynamic exactly-once / slow-worker shift / respawn) =="
 python scripts/data_drill.py
 
+echo "== disagg drill (prefill-burst interference / torn-stalled-crashed handoff / prefill-tier drain) =="
+python scripts/disagg_drill.py
+
 echo "== bench smoke (JSON contract) =="
 python bench.py --smoke
 

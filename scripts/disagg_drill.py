@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Disaggregated prefill/decode drills: prove the tiered fleet isolates
+decode latency from prefill bursts and that the KV handoff is a real
+fault domain — torn transfers, mid-transfer crashes, and stalls all end
+in byte-exact re-prefill, and a draining prefill tier strands nothing.
+
+Five scenarios through the `Scenario` DSL (resilience/chaos.py), each
+driving a REAL router over REAL engine replicas inline under a
+`VirtualClock` (zero sleeps), with the handoff bus moving int8-capable
+KV pages over REAL loopback sockets:
+
+  interference      the headline claim: short decode "victims" run while
+                    a burst of long prompts arrives.  In the colocated
+                    arm the victims' host engines also chew prefill
+                    chunks, so their inter-token wall time degrades; in
+                    the disaggregated arm the decode tier never prefills
+                    (structural check: its estimator has NO prefill
+                    observations, all joins are remote) and victim
+                    inter-token p99 stays flat
+  torn_handoff      a bit-flipped page fails its CRC at the decode side:
+                    nack -> re-prefill elsewhere, byte-exact output
+  crash_mid_transfer the prefill replica dies after the first page: the
+                    watchdog fails the transfer, the replica is ejected,
+                    the request re-prefills byte-exact on the survivor
+  stalled_handoff   a sender freezes mid-transfer: the bounded-timeout
+                    watchdog kills the transfer and re-prefill completes
+                    byte-exact — no transfer waits forever
+  prefill_drain     SIGTERM semantics: a draining prefill replica first
+                    FINISHES its in-flight transfers (zero dropped
+                    decodes), then stops; the routing timeline carries
+                    `replica_drained`
+
+Corruption check: greedy decode is deterministic, so every completed
+response must EXACTLY equal the offline `DecodeEngine.generate` tokens
+— the handoff is transport, never arithmetic.  Exit 0 only when every
+scenario passes.  `make disagg-drill` is the entry point; scripts/
+check.sh runs it in the gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from time import monotonic
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from serve_drill import build_bundle, reference_tokens  # noqa: E402
+
+LONG = 40          # long-prompt length (bucket 64: the expensive prefill)
+SHORT = 5          # victim prompt length (bucket 8: decodes immediately)
+
+
+def make_tiers(bundle, clock, *, prefill=2, decode=1, serve_overrides=None,
+               **router_kw):
+    from mmlspark_tpu.serve import RouterConfig, ServeConfig, build_fleet
+    skw = dict(max_new_tokens=12, max_batch=4, queue_capacity=16,
+               segment_steps=4, default_deadline_s=120.0,
+               drain_timeout_s=60.0, cache_chunk=8, prefill_chunk=8,
+               cache_dtype="int8")
+    skw.update(serve_overrides or {})
+    rkw = dict(replicas=prefill + decode, prefill_replicas=prefill,
+               decode_replicas=decode, queue_capacity=32,
+               default_deadline_s=120.0, drain_timeout_s=60.0,
+               retry_budget_cap=8.0, retry_budget_per_s=1.0,
+               eject_failures=3, probe_reset_s=5.0, hang_timeout_s=30.0)
+    rkw.update(router_kw)
+    return build_fleet(bundle, cfg=RouterConfig(**rkw),
+                       serve_cfg=ServeConfig(**skw), clock=clock)
+
+
+def make_colocated(bundle, clock, *, n=2, serve_overrides=None, **router_kw):
+    from mmlspark_tpu.serve import RouterConfig, ServeConfig, build_fleet
+    skw = dict(max_new_tokens=12, max_batch=4, queue_capacity=16,
+               segment_steps=4, default_deadline_s=120.0,
+               drain_timeout_s=60.0, cache_chunk=8, prefill_chunk=8,
+               cache_dtype="int8")
+    skw.update(serve_overrides or {})
+    rkw = dict(replicas=n, queue_capacity=32, default_deadline_s=120.0,
+               drain_timeout_s=60.0, retry_budget_cap=8.0,
+               retry_budget_per_s=1.0, eject_failures=3,
+               probe_reset_s=5.0, hang_timeout_s=30.0)
+    rkw.update(router_kw)
+    return build_fleet(bundle, cfg=RouterConfig(**rkw),
+                       serve_cfg=ServeConfig(**skw), clock=clock)
+
+
+def _time_ticks(router):
+    """Wrap every replica engine's `_tick` to accumulate real wall
+    seconds per replica — the per-tier compute clock the interference
+    metric reads (virtual time can't see compute cost)."""
+    spent = {}
+    for rep in router.replicas:
+        spent[rep.name] = 0.0
+
+        def wrap(inner, name):
+            def timed():
+                t0 = monotonic()
+                try:
+                    return inner()
+                finally:
+                    spent[name] += monotonic() - t0
+            return timed
+
+        rep.engine._tick = wrap(rep.engine._tick, rep.name)
+    return spent
+
+
+def drive(router, clock, requests, *, max_ticks=4000, advance=0.05,
+          on_tick=None):
+    ticks = 0
+    while not all(r.finished for r in requests) and ticks < max_ticks:
+        worked = router._tick()
+        if on_tick is not None:
+            on_tick()
+        if not worked:
+            clock.advance(advance)
+        ticks += 1
+    return ticks
+
+
+def finish_obs(bundle, router, requests, obs):
+    """The shared tail every scenario asserts on: status counts,
+    byte-exactness against the offline oracle, handoff stats."""
+    exact = corrupt = 0
+    for r in requests:
+        if r.status != "ok":
+            continue
+        if r.tokens == reference_tokens(bundle, r.prompt.tolist(),
+                                        r.max_new_tokens):
+            exact += 1
+        else:
+            corrupt += 1
+    stats = router.stats()
+    hand = stats.get("handoff", {})
+    obs.update({
+        "ok": sum(1 for r in requests if r.status == "ok"),
+        "error": sum(1 for r in requests if r.status == "error"),
+        "cancelled": sum(1 for r in requests if r.status == "cancelled"),
+        "timeout": sum(1 for r in requests if r.status == "timeout"),
+        "unfinished": sum(1 for r in requests if not r.finished),
+        "exact": exact, "corrupt": corrupt,
+        "ejections": stats.get("ejections", 0),
+        "handoff_spliced": hand.get("spliced", 0),
+        "handoff_retries": hand.get("retries", 0),
+        "handoff_bytes": hand.get("bytes_sent", 0),
+    })
+    return obs
+
+
+def prompts_for(seed, n, length):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 60, (length,)).astype(np.int32)
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def _interference_arm(bundle, tiered: bool):
+    """One arm of the interference experiment: 3 long-decoding victims
+    admitted first, then a burst of 6 long prompts.  Returns the victim
+    inter-token gaps measured on each victim's HOST engine's wall-clock
+    (the engine currently decoding it), plus the structural tier facts."""
+    from mmlspark_tpu.resilience.clock import VirtualClock
+    clock = VirtualClock()
+    over = {"max_new_tokens": 24}
+    if tiered:
+        router = make_tiers(bundle, clock, prefill=2, decode=1,
+                            serve_overrides=over)
+    else:
+        router = make_colocated(bundle, clock, n=3, serve_overrides=over)
+    router.warmup()
+    spent = _time_ticks(router)
+
+    def run_pass(measure: bool):
+        victims = [router.submit(p, max_new_tokens=24)
+                   for p in prompts_for(21, 3, SHORT)]
+        burst = [router.submit(p, max_new_tokens=4)
+                 for p in prompts_for(22, 6, LONG)]
+        seen = {r.id: (0, None, None) for r in victims}
+        gaps = []
+
+        def on_tick():
+            for rr in victims:
+                atts = rr.attempts
+                if not atts:
+                    continue
+                host = atts[-1][0]
+                n_tok, old_host, mark = seen[rr.id]
+                cur = len(rr.stream_state()[1])
+                if cur > n_tok:
+                    if mark is not None and host == old_host:
+                        gaps.append((spent[host] - mark) / (cur - n_tok))
+                    seen[rr.id] = (cur, host, spent[host])
+
+        requests = victims + burst
+        drive(router, clock, requests,
+              on_tick=on_tick if measure else None)
+        return requests, gaps
+
+    # pass 1 compiles every bucket program (join, chunk prefill, remote
+    # join, decode) so the measured pass sees steady-state tick costs,
+    # not one-time XLA compiles
+    run_pass(measure=False)
+    requests, gaps = run_pass(measure=True)
+
+    decode_reps = [rep for rep in router.replicas
+                   if getattr(rep, "role", None) == "decode"]
+    tier_prefills = sum(len(rep.engine.estimator._prefill)
+                        for rep in decode_reps)
+    remote_joins = sum(rep.engine._counts.get("remote_joins", 0)
+                      for rep in decode_reps)
+    p99 = float(np.percentile(np.asarray(gaps), 99)) if gaps else 0.0
+    return router, requests, p99, tier_prefills, remote_joins
+
+
+def scenario_interference(bundle):
+    """Decode-tier inter-token p99 stays flat under a long-prompt burst;
+    the colocated arm measurably degrades (its victims' engines also
+    chew prefill chunks between their tokens)."""
+    from mmlspark_tpu.resilience.chaos import Scenario, run_scenario
+
+    scenario = Scenario(
+        "interference",
+        faults=[],
+        expect={"ok": 9, "error": 0, "corrupt": 0, "unfinished": 0,
+                "decode_tier_prefills": 0, "min_remote_joins": 9,
+                "min_p99_ratio": 1.2, "coloc_ok": 9, "coloc_corrupt": 0})
+
+    def run():
+        router, requests, disagg_p99, tier_prefills, remote_joins = \
+            _interference_arm(bundle, tiered=True)
+        obs = finish_obs(bundle, router, requests, {
+            "decode_tier_prefills": tier_prefills,
+            "remote_joins": remote_joins,
+            "disagg_inter_token_p99_s": round(disagg_p99, 6)})
+        _, coloc_reqs, coloc_p99, _, _ = \
+            _interference_arm(bundle, tiered=False)
+        obs["coloc_inter_token_p99_s"] = round(coloc_p99, 6)
+        obs["coloc_ok"] = sum(1 for r in coloc_reqs if r.status == "ok")
+        obs["coloc_corrupt"] = sum(
+            1 for r in coloc_reqs if r.status == "ok"
+            and r.tokens != reference_tokens(bundle, r.prompt.tolist(),
+                                             r.max_new_tokens))
+        obs["p99_ratio"] = round(coloc_p99 / disagg_p99, 3) \
+            if disagg_p99 > 0 else float("inf")
+        return obs
+
+    return run_scenario(scenario, run)
+
+
+def _fault_scenario(bundle, name, faults, expect, *, pages_per_tick=1):
+    """Shared shape of the three transfer-fault scenarios: a small
+    mixed-length workload over 2 prefill + 1 decode with the fault
+    injected at the bus, everything must still finish byte-exact."""
+    from mmlspark_tpu.resilience.chaos import Scenario, run_scenario
+    from mmlspark_tpu.resilience.clock import VirtualClock
+
+    scenario = Scenario(name, faults=faults, expect=expect)
+
+    def run():
+        # run_scenario installed the fault script; the handoff bus
+        # consults it via handoff_faults_due at each transfer
+        clock = VirtualClock()
+        router = make_tiers(bundle, clock, prefill=2, decode=1,
+                            handoff_pages_per_tick=pages_per_tick)
+        router.warmup()
+        prompts = (prompts_for(31, 2, SHORT)
+                   + prompts_for(32, 2, 14))
+        requests = [router.submit(p) for p in prompts]
+        drive(router, clock, requests)
+        return finish_obs(bundle, router, requests, {})
+
+    return run_scenario(scenario, run)
+
+
+def scenario_torn_handoff(bundle):
+    """A bit-flipped KV page fails its CRC at the decode side: the
+    transfer is nacked and the request re-prefills — byte-exact."""
+    from mmlspark_tpu.resilience.chaos import Fault
+    return _fault_scenario(
+        bundle, "torn_handoff",
+        faults=[Fault(kind="handoff_torn", at_request=1)],
+        expect={"ok": 4, "error": 0, "corrupt": 0, "unfinished": 0,
+                "min_handoff_retries": 1, "min_handoff_spliced": 4})
+
+
+def scenario_crash_mid_transfer(bundle):
+    """The prefill replica dies after shipping its first page: the
+    transfer fails over, the replica is ejected, and the re-prefill on
+    the survivor is byte-exact."""
+    from mmlspark_tpu.resilience.chaos import Fault
+    return _fault_scenario(
+        bundle, "crash_mid_transfer",
+        faults=[Fault(kind="prefill_crash_mid_transfer", at_request=2)],
+        expect={"ok": 4, "error": 0, "corrupt": 0, "unfinished": 0,
+                "min_handoff_retries": 1, "min_ejections": 1})
+
+
+def scenario_stalled_handoff(bundle):
+    """A sender freezes mid-transfer: the bounded-timeout watchdog fails
+    the transfer instead of waiting forever, and re-prefill completes
+    byte-exact."""
+    from mmlspark_tpu.resilience.chaos import Fault
+    return _fault_scenario(
+        bundle, "stalled_handoff",
+        faults=[Fault(kind="handoff_stall", at_request=1, seconds=30.0)],
+        expect={"ok": 4, "error": 0, "corrupt": 0, "unfinished": 0,
+                "min_handoff_retries": 1})
+
+
+def scenario_prefill_drain(bundle):
+    """SIGTERM on a prefill replica: it finishes its in-flight transfers
+    before stopping — zero dropped decodes, `replica_drained` lands in
+    the routing timeline, and the decode tier never notices."""
+    from mmlspark_tpu.resilience.chaos import Scenario, run_scenario
+    from mmlspark_tpu.resilience.clock import VirtualClock
+
+    scenario = Scenario(
+        "prefill_drain",
+        faults=[],
+        expect={"ok": 6, "error": 0, "cancelled": 0, "corrupt": 0,
+                "unfinished": 0, "p0_stopped": True,
+                "replica_drained_event": True})
+
+    def run():
+        from mmlspark_tpu.observe.telemetry import active_run
+        clock = VirtualClock()
+        router = make_tiers(bundle, clock, prefill=2, decode=1)
+        router.warmup()
+        prompts = prompts_for(41, 4, 14) + prompts_for(42, 2, SHORT)
+        requests = [router.submit(p) for p in prompts]
+        router._tick()                  # let work land on both p-replicas
+        p0 = next(r for r in router.replicas if r.name == "p0")
+        p0.begin_drain("sigterm")       # the lifecycle SIGTERM path
+        drive(router, clock, requests)
+        run = active_run()
+        drained = any(
+            e.get("event") == "replica_drained"
+            and e.get("replica") == "p0"
+            for e in (run._routing if run is not None else []))
+        return finish_obs(bundle, router, requests, {
+            "p0_stopped": p0.engine.state == "stopped",
+            "replica_drained_event": drained})
+
+    return run_scenario(scenario, run)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report only")
+    args = parser.parse_args()
+
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+
+    bundle = build_bundle()
+    reports = []
+    with tempfile.TemporaryDirectory() as td:
+        with run_telemetry(td):
+            for scenario_fn in (scenario_interference,
+                                scenario_torn_handoff,
+                                scenario_crash_mid_transfer,
+                                scenario_stalled_handoff,
+                                scenario_prefill_drain):
+                reports.append(scenario_fn(bundle))
+
+    passed = all(r["passed"] for r in reports)
+    if args.json:
+        print(json.dumps({"passed": passed, "scenarios": reports}))
+    else:
+        for r in reports:
+            status = "PASS" if r["passed"] else "FAIL"
+            print(f"[{status}] {r['name']}")
+            for key, c in r["checks"].items():
+                mark = "ok" if c["ok"] else "WANT %r GOT %r" % (
+                    c["want"], c["got"])
+                print(f"    {key}: {mark}")
+            if not r["passed"]:
+                print(f"    observed: {r['observed']}")
+        print("DISAGG DRILL " + ("OK" if passed else "FAILED"))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -441,3 +441,83 @@ def test_process_mode_images_via_read_images_iter(tmp_path):
         assert list(a["path"]) == list(b["path"])
         np.testing.assert_array_equal(np.asarray(a["image"]),
                                       np.asarray(b["image"]))
+
+
+# ---------------------------------------------------------------------------
+# transport page frames (the KV-handoff wire format)
+# ---------------------------------------------------------------------------
+
+def test_page_frame_roundtrips_with_crc():
+    from mmlspark_tpu.data.service.transport import (FrameBuffer,
+                                                     encode_page)
+    buf = FrameBuffer()
+    payload = bytes(range(256)) * 3
+    buf.feed(encode_page(7, 2, payload))
+    frames = list(buf.frames())
+    assert frames == [("page", 7, 2, payload)]
+    assert buf.pending() == 0
+
+
+def test_bit_flipped_page_rejected_and_stream_resumes():
+    """A corrupt page fails crc32 AT PARSE TIME with the request/page
+    identity attached, the bad frame is consumed, and the NEXT frame
+    parses cleanly — one torn transfer never wedges the link."""
+    from mmlspark_tpu.data.service.transport import (FrameBuffer,
+                                                     TransportError,
+                                                     encode_json,
+                                                     encode_page)
+    bad = bytearray(encode_page(9, 0, b"x" * 64))
+    bad[-1] ^= 0xFF
+    buf = FrameBuffer()
+    buf.feed(bytes(bad))
+    buf.feed(encode_json({"t": "kv_ack", "req": 9}))
+    with pytest.raises(TransportError, match="crc32") as ei:
+        list(buf.frames())
+    assert ei.value.request_id == 9 and ei.value.page_index == 0
+    # the corrupt frame was consumed; iteration resumes on the ack
+    assert list(buf.frames()) == [("json", {"t": "kv_ack", "req": 9})]
+
+
+def test_truncated_page_header_and_torn_length_rejected():
+    import struct
+    import zlib
+    from mmlspark_tpu.data.service.transport import (FrameBuffer,
+                                                     TransportError)
+    hdr = struct.Struct(">IB")
+    page = struct.Struct(">IIII")
+    # header claims more bytes than the frame carries
+    data = b"y" * 10
+    payload = page.pack(3, 1, 99, zlib.crc32(data)) + data
+    buf = FrameBuffer()
+    buf.feed(hdr.pack(len(payload) + 1, 0x4B) + payload)
+    with pytest.raises(TransportError, match="torn page"):
+        list(buf.frames())
+    # page frame too short to even hold the page header
+    buf2 = FrameBuffer()
+    buf2.feed(hdr.pack(4 + 1, 0x4B) + b"zzzz")
+    with pytest.raises(TransportError, match="truncated page header"):
+        list(buf2.frames())
+
+
+def test_read_frame_bounded_timeout_and_torn_close():
+    import socket
+    from mmlspark_tpu.data.service.transport import (FrameBuffer,
+                                                     TransportError,
+                                                     encode_json,
+                                                     read_frame)
+    a, b = socket.socketpair()
+    try:
+        # a stalled peer surfaces as a typed error, never a hang
+        with pytest.raises(TransportError, match="stalled"):
+            read_frame(a, FrameBuffer(), timeout_s=0.05)
+        # a whole frame reads fine
+        b.sendall(encode_json({"ok": 1}))
+        assert read_frame(a, FrameBuffer(), 1.0) == ("json", {"ok": 1})
+        # a peer closing mid-frame is a torn frame, not a short read
+        frame = encode_json({"big": "x" * 64})
+        b.sendall(frame[:7])
+        b.close()
+        with pytest.raises(TransportError, match="torn frame"):
+            read_frame(a, FrameBuffer(), 1.0)
+    finally:
+        a.close()

@@ -358,3 +358,195 @@ def test_http_router_statz_and_streaming(bundle, offline):
         stop_ticking.set()
         tick_thread.join(timeout=5)
         router.stop()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode tiers (serve/handoff.py)
+# ---------------------------------------------------------------------------
+
+def _grid_prompts(seed=3):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 60, size=n).astype(np.int32)
+            for n in (5, 9, 14, 7)]
+
+
+def _run_prompts(bundle, prompts, serve_overrides=None, faults=None,
+                 deadline_s=None, **rkw):
+    """One fleet, one workload, torn down: [(status, tokens)] per
+    request plus the router for post-mortem assertions."""
+    from mmlspark_tpu.resilience.chaos import (ChaosInjector, get_injector,
+                                               set_injector)
+    clock = VirtualClock()
+    prev = get_injector()
+    set_injector(ChaosInjector(script=faults) if faults else None)
+    try:
+        router = make_fleet(bundle, clock, serve_overrides=serve_overrides,
+                            **rkw)
+        reqs = [router.submit(p, deadline_s=deadline_s) for p in prompts]
+        drive(router, clock, reqs, max_ticks=1200)
+    finally:
+        set_injector(prev)
+    return [(r.status, tuple(r.tokens)) for r in reqs], router
+
+
+# tier-1 keeps the richest cell (chunked prefill + int8 KV pages + the
+# crash arm); the other three cells run in test-full — each arm builds
+# and compiles three fleets, so the full grid is minutes of XLA
+@pytest.mark.parametrize("cache_dtype", [
+    pytest.param("model", marks=pytest.mark.slow), "int8"])
+@pytest.mark.parametrize("prefill_chunk", [
+    pytest.param(0, marks=pytest.mark.slow), 8])
+def test_disagg_byte_exact_grid(bundle, cache_dtype, prefill_chunk):
+    """Colocated and disaggregated fleets produce IDENTICAL greedy
+    outputs across {model-dtype, int8-KV} x {unchunked, chunked prefill}
+    x {clean, prefill-crash-mid-transfer} — the handoff moves bits, it
+    never changes them, even when the transfer has to re-prefill."""
+    from mmlspark_tpu.resilience.chaos import Fault
+    prompts = _grid_prompts()
+    over = {"cache_dtype": cache_dtype, "prefill_chunk": prefill_chunk,
+            "cache_chunk": 8}
+    ref, _ = _run_prompts(bundle, prompts, serve_overrides=over)
+    assert all(s == "ok" for s, _ in ref)
+
+    got, router = _run_prompts(bundle, prompts, serve_overrides=over,
+                               prefill_replicas=2, decode_replicas=1)
+    assert got == ref
+    hs = router.stats()["handoff"]
+    assert hs["spliced"] == len(prompts) and hs["retries"] == 0
+    if cache_dtype == "int8":
+        # int8 rows ship fewer bytes than the model dtype would
+        assert hs["bytes_sent"] < 26000
+
+    crashed, router = _run_prompts(
+        bundle, prompts, serve_overrides=over,
+        prefill_replicas=2, decode_replicas=1, handoff_pages_per_tick=1,
+        faults=[Fault(kind="prefill_crash_mid_transfer", at_request=2)])
+    assert crashed == ref
+    st = router.stats()
+    assert st.get("handoff_retries", 0) >= 1
+    assert st.get("ejections", 0) >= 1
+
+
+@pytest.mark.slow  # scripts/disagg_drill.py gates the same faults in check.sh
+def test_disagg_torn_and_stalled_handoffs_reprefill_byte_exact(bundle):
+    from mmlspark_tpu.resilience.chaos import Fault
+    prompts = _grid_prompts(seed=5)
+    over = {"cache_chunk": 8}
+    ref, _ = _run_prompts(bundle, prompts, serve_overrides=over)
+    for fault in (Fault(kind="handoff_torn", at_request=2),
+                  Fault(kind="handoff_stall", at_request=2, seconds=30.0)):
+        got, router = _run_prompts(
+            bundle, prompts, serve_overrides=over, prefill_replicas=2,
+            decode_replicas=1, handoff_pages_per_tick=1, faults=[fault])
+        assert got == ref, fault.kind
+        assert router.stats().get("handoff_retries", 0) >= 1, fault.kind
+        assert router.stats()["handoff"]["retries"] >= 1
+
+
+def test_cancel_at_splice_lands_cancel_event_refunds_nothing(bundle,
+                                                             tmp_path):
+    """A request whose deadline expires while its KV pages are in flight
+    is cancelled AT SPLICE: `serve.route.cancel` lands in the routing
+    timeline and the retry budget is untouched (satellite: no refund,
+    no spend)."""
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+    from mmlspark_tpu.resilience.chaos import (ChaosInjector, Fault,
+                                               set_injector)
+    clock = VirtualClock()
+    set_injector(ChaosInjector(script=[
+        Fault(kind="handoff_stall", at_request=1, seconds=5.0)]))
+    try:
+        with run_telemetry(str(tmp_path)) as rt:
+            router = make_fleet(bundle, clock, prefill_replicas=1,
+                                decode_replicas=1,
+                                handoff_timeout_s=60.0,
+                                serve_overrides={"cache_chunk": 8})
+            rr = router.submit(_grid_prompts()[0], deadline_s=2.0)
+            for _ in range(1200):
+                if rr.finished:
+                    break
+                if not router._tick():
+                    clock.advance(0.05)
+            summary = rt.summary()
+    finally:
+        set_injector(None)
+    assert rr.status == "timeout"
+    assert "splice" in rr.detail
+    cancels = [e for e in summary["routing"] if e["event"] == "cancel"]
+    assert cancels and cancels[0]["reason"] == "deadline_at_splice"
+    assert router.budget.spent == 0
+    assert router.stats()["handoff"]["cancelled_at_splice"] == 1
+    handoff_events = [e["event"] for e in summary["handoff"]]
+    assert "begin" in handoff_events
+    assert "cancel_at_splice" in handoff_events
+
+
+def test_disagg_statz_tiers_and_prometheus_gauges(bundle, tmp_path):
+    """/statz grows per-tier sections and the run exports
+    mmlspark_tpu_handoff_{bytes,inflight,retries} gauges."""
+    from mmlspark_tpu.observe.export import prometheus_text
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+    clock = VirtualClock()
+    with run_telemetry(str(tmp_path)) as rt:
+        router = make_fleet(bundle, clock, prefill_replicas=2,
+                            decode_replicas=1)
+        reqs = submit_n(router, 4)
+        drive(router, clock, reqs)
+        stats = router.stats()
+        text = prometheus_text(rt)
+        router.stop()
+    assert [r.status for r in reqs] == ["ok"] * 4
+    tiers = stats["tiers"]
+    assert tiers["prefill"]["replicas"] == ["p0", "p1"]
+    assert tiers["decode"]["replicas"] == ["d0"]
+    for key in ("routable", "queued", "in_flight", "load_tokens"):
+        assert key in tiers["prefill"] and key in tiers["decode"]
+    assert stats["handoff"]["spliced"] == 4
+    assert stats["replicas"]["p0"]["role"] == "prefill"
+    assert stats["replicas"]["d0"]["role"] == "decode"
+    for metric in ("mmlspark_tpu_handoff_bytes",
+                   "mmlspark_tpu_handoff_inflight",
+                   "mmlspark_tpu_handoff_retries"):
+        assert metric in text, metric
+    # tier breakers get their own keying in the registry exposition
+    assert 'serve.prefill.p0' in text and 'serve.decode.d0' in text
+
+
+def test_prefill_replica_drain_finishes_transfers(bundle, tmp_path):
+    """SIGTERM on one prefill replica: it finishes its in-flight
+    prefills AND KV transfers, then stops — zero dropped decodes, the
+    rest of the tier keeps serving."""
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+    clock = VirtualClock()
+    with run_telemetry(str(tmp_path)) as rt:
+        router = make_fleet(bundle, clock, prefill_replicas=2,
+                            decode_replicas=1,
+                            serve_overrides={"cache_chunk": 8})
+        reqs = submit_n(router, 6)
+        router._tick()
+        router._by_name["p0"].begin_drain("sigterm")
+        drive(router, clock, reqs)
+        # p0 must reach stopped on its own once its transfers finish
+        for _ in range(200):
+            if router._by_name["p0"].engine.state == "stopped":
+                break
+            if not router._tick():
+                clock.advance(0.05)
+        summary = rt.summary()
+        router.stop()
+    assert [r.status for r in reqs] == ["ok"] * 6
+    assert router._by_name["p0"].engine.state == "stopped"
+    drained = [e for e in summary["routing"]
+               if e["event"] == "replica_drained"]
+    assert drained and drained[0]["replica"] == "p0"
+    # p1 took over: still routable until the final stop
+    assert router.stats()["replicas"]["p1"]["role"] == "prefill"
+
+
+def test_tiered_config_validation(bundle):
+    with pytest.raises(ValueError, match="BOTH"):
+        RouterConfig(replicas=2, prefill_replicas=1, decode_replicas=0)
+    with pytest.raises(ValueError, match="spec"):
+        ServeConfig(role="prefill", spec_tokens=3)
+    with pytest.raises(ValueError):
+        ServeConfig(role="nonsense")

@@ -7,6 +7,7 @@ against the offline DecodeEngine as the corruption oracle.
 import json
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -688,3 +689,61 @@ def test_streaming_flushes_at_segment_boundaries(bundle, offline):
         stop_ticking.set()
         tick_thread.join(timeout=5)
         engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# KV cache row paging (models/generate.py serialize/deserialize_cache_row)
+# ---------------------------------------------------------------------------
+
+def _fake_caches(dtype, n_layers=2, batch=3, width=24, heads=4, dh=8):
+    rng = np.random.default_rng(0)
+    if dtype == "int8":
+        return [(jnp.asarray(rng.integers(-127, 127,
+                                          (batch, width, heads, dh)),
+                             jnp.int8),
+                 jnp.asarray(rng.normal(size=(batch, width, heads)),
+                             jnp.float32),
+                 jnp.asarray(rng.integers(-127, 127,
+                                          (batch, width, heads, dh)),
+                             jnp.int8),
+                 jnp.asarray(rng.normal(size=(batch, width, heads)),
+                             jnp.float32))
+                for _ in range(n_layers)]
+    return [(jnp.asarray(rng.normal(size=(batch, width, heads, dh)),
+                         dtype),
+             jnp.asarray(rng.normal(size=(batch, width, heads, dh)),
+                         dtype))
+            for _ in range(n_layers)]
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float32", "int8"])
+@pytest.mark.parametrize("chunk", [8, 16, 100])
+def test_cache_row_pages_roundtrip_byte_exact(dtype, chunk):
+    from mmlspark_tpu.models.generate import (deserialize_cache_row,
+                                              serialize_cache_row)
+    caches = _fake_caches(dtype)
+    pages = serialize_cache_row(caches, 1, chunk)
+    import math
+    assert len(pages) == math.ceil(24 / chunk)
+    back = deserialize_cache_row(pages)
+    assert len(back) == len(caches)
+    for src_layer, dst_layer in zip(caches, back):
+        assert len(src_layer) == len(dst_layer)
+        for src, dst in zip(src_layer, dst_layer):
+            assert dst.shape == (1,) + src.shape[1:]
+            assert dst.dtype == src.dtype
+            np.testing.assert_array_equal(np.asarray(src[1]),
+                                          np.asarray(dst[0]))
+
+
+def test_cache_row_pages_reject_garbage():
+    from mmlspark_tpu.models.generate import (deserialize_cache_row,
+                                              serialize_cache_row)
+    with pytest.raises(ValueError, match="empty"):
+        deserialize_cache_row([])
+    pages = serialize_cache_row(_fake_caches("float32"), 0, 8)
+    with pytest.raises(ValueError):
+        deserialize_cache_row([pages[0][:10]])   # truncated blob
+    other = serialize_cache_row(_fake_caches("int8"), 0, 8)
+    with pytest.raises(ValueError, match="layout"):
+        deserialize_cache_row([pages[0], other[1]])

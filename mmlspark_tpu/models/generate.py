@@ -1204,6 +1204,32 @@ class DecodeEngine:
                           for kc, vc in caches]
             return tok, done, caches
 
+        def resume_init_impl(w0, row_caches):
+            """Open a RESUMED chunked prefill from a donor prefix row
+            (serve/prefix_cache.py): dequantize int8 donor slots back to
+            model dtype (suffix chunks keep writing through the same
+            model-dtype cache the fresh path uses; `prefill_finish_impl`
+            re-quantizes the whole window, and quantize_kv's round-trip
+            idempotency keeps the stored prefix bytes identical), grow
+            to the bucket window, and zero the running last-position
+            logits — the matched prefix is always strictly inside the
+            prompt, so a later chunk's `here` mask recomputes them."""
+            caches = []
+            for layer in row_caches:
+                if len(layer) == 4:
+                    kq, ks, vq, vs = layer
+                    k = (kq.astype(jnp.float32)
+                         * ks[..., None]).astype(module.dtype)
+                    v = (vq.astype(jnp.float32)
+                         * vs[..., None]).astype(module.dtype)
+                else:
+                    k, v = layer
+                caches.append((_hint_kv(_grow_cache(k, w0)),
+                               _hint_kv(_grow_cache(v, w0))))
+            b = row_caches[0][0].shape[0]
+            last = jnp.zeros((b, module.vocab_size), jnp.float32)
+            return caches, last
+
         def draft_prefill_impl(draft_variables, prompts):
             """Prefill the DRAFT model's cache over the prompt
             (speculative decoding) — same window arithmetic as the
@@ -1421,6 +1447,10 @@ class DecodeEngine:
             with use_mesh(mesh):
                 return prefill_finish_impl(*args)
 
+        def resume_init_meshed(w0, row_caches):
+            with use_mesh(mesh):
+                return resume_init_impl(w0, row_caches)
+
         self._prefill = jax.jit(prefill_meshed)
         self._segment = jax.jit(segment_meshed, static_argnums=(0, 1))
         self._serve_segment = jax.jit(serve_segment_meshed,
@@ -1429,6 +1459,8 @@ class DecodeEngine:
                                        static_argnums=(0,))
         self._prefill_chunk = jax.jit(prefill_chunk_meshed)
         self._prefill_finish = jax.jit(prefill_finish_meshed)
+        self._resume_init = jax.jit(resume_init_meshed,
+                                    static_argnums=(0,))
         if spec_tokens:
             def draft_prefill_meshed(draft_variables, prompts):
                 with use_mesh(mesh):
@@ -1556,6 +1588,57 @@ class DecodeEngine:
                                                  row_keys)
         self._program("prefill_finish", b, w0)
         return tok, done, caches
+
+    def serve_resume_chunks(self, bucket: int, prefix_len: int) -> int:
+        """How many SUFFIX chunks a chunk-interleaved resume from a
+        `prefix_len`-token donor prefix runs (0 = resume inline via
+        `serve_prefill_resume`: chunking off for this bucket, or the
+        prefix is not prefill_chunk-aligned)."""
+        total = self.serve_prefill_chunks(bucket)
+        cl = self.prefill_chunk
+        if (not total or prefix_len <= 0 or prefix_len >= bucket
+                or prefix_len % cl):
+            return 0
+        return total - prefix_len // cl
+
+    def serve_resume_init(self, row_caches, bucket: int):
+        """Open a resumed prefill from donor prefix rows (the prefix
+        pool's spliced-together chunk payloads, slot width = matched
+        prefix): dequantize/grow to the bucket window and zero the
+        running logits — a (caches, last) state `serve_prefill_chunk`
+        (index >= 1) and `serve_prefill_finish` continue verbatim."""
+        w0 = _round_up(bucket + 1, self.chunk)
+        b = int(row_caches[0][0].shape[0])
+        n = int(row_caches[0][0].shape[1])
+        state = self._resume_init(w0, row_caches)
+        self._program("resume_init", b, n, w0, len(row_caches[0]))
+        return state
+
+    def serve_prefill_resume(self, variables, prompts, true_len,
+                             prefix_len: int, row_caches, live, row_keys):
+        """Prefill ONLY the novel suffix of a prompt whose first
+        `prefix_len` tokens have donor cache rows (prefix pool hit):
+        one `prefill_chunk` call at traced offset `prefix_len` over the
+        whole suffix, then the standard finish.  The dense full-cache
+        attention path makes the suffix forward attend the donor slots
+        exactly as a fresh prefill would its own — byte-identical
+        greedy outputs are the contract (model-dtype rows exact; int8
+        rows carry the documented quantization caveat).  Same
+        (tok, done, caches) contract as `serve_prefill`."""
+        prompts = np.asarray(prompts)
+        b, p = prompts.shape
+        if not 0 < prefix_len < p:
+            raise ValueError(
+                f"prefix_len ({prefix_len}) must be inside the bucket "
+                f"({p})")
+        caches, last = self.serve_resume_init(row_caches, p)
+        w0 = int(caches[0][0].shape[1])
+        tokens = jnp.asarray(prompts[:, prefix_len:])
+        state = self._prefill_chunk(
+            variables, tokens, caches, last, jnp.asarray(true_len),
+            jnp.asarray(prefix_len, jnp.int32))
+        self._program("prefill_chunk", b, p - prefix_len, w0)
+        return self.serve_prefill_finish(state, live, row_keys)
 
     def serve_draft_prefill(self, draft_variables, prompts):
         """Prefill the draft model's cache for a join cohort (speculative

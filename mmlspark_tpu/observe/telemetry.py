@@ -104,6 +104,10 @@ class RunTelemetry:
         # begin/page/splice/fail events in order — what the disagg drill
         # asserts its re-prefill and cancel-at-splice invariants against
         self._handoff: list[dict] = []
+        # the run's prefix-pool timeline (serve/prefix_cache.py via
+        # serve/engine.py): hit, insert, evict, evict_refused events in
+        # order — what the eviction-under-lease drill asserts against
+        self._prefix: list[dict] = []
         # the run's data-service timeline (data/service/dispatcher.py):
         # split dispatch/completion, worker death, re-dispatch, scaling —
         # what the data drill asserts its recovery invariants against
@@ -256,6 +260,20 @@ class RunTelemetry:
         self.tracer._record({"type": "handoff",
                              "ts": round(self.tracer.now(), 6), **rec})
 
+    def record_prefix(self, event: dict) -> None:
+        """Append one prefix-pool event (serve/prefix_cache.py decisions
+        surfaced by serve/engine.py) to the run's ordered timeline (also
+        streamed as a `prefix` record); the full list lands in
+        run_summary.json under `prefix` — every hit (matched/suffix
+        split), insert, eviction, and refused-under-lease eviction,
+        machine-readable for the prefix drills."""
+        if not self.live:
+            return
+        rec = dict(event)
+        self._prefix.append(rec)
+        self.tracer._record({"type": "prefix",
+                             "ts": round(self.tracer.now(), 6), **rec})
+
     def record_data_service(self, event: dict) -> None:
         """Append one data-service event (data/service/dispatcher.py) to
         the run's ordered timeline (also streamed as a `data_service`
@@ -307,6 +325,7 @@ class RunTelemetry:
             "serve": [dict(e) for e in self._serve],
             "routing": [dict(e) for e in self._routing],
             "handoff": [dict(e) for e in self._handoff],
+            "prefix": [dict(e) for e in self._prefix],
             "data_service": [dict(e) for e in self._data_service],
             "trace_records_dropped": self.tracer.dropped,
         }

@@ -24,6 +24,10 @@ ROADMAP's "millions of users" north star needs:
     chunk pages over transport frames to the decode tier, with
     acks, watchdogs, and re-prefill failover (docs/serving.md
     "Disaggregated tiers");
+  * `prefix_cache` — the cross-request radix prefix KV cache: chunk-
+    granular trie of finished cache rows with LRU eviction and lease
+    pinning, so requests sharing a prompt prefix prefill only their
+    novel suffix (docs/serving.md "Prefix reuse & priority lanes");
   * `http` — stdlib-only request front end + health endpoints
     (`/healthz`, `/readyz`, POST `/generate` with optional chunked
     token streaming), next to `observe/export.serve_metrics`.
@@ -38,6 +42,7 @@ from mmlspark_tpu.serve.engine import ServeConfig, ServingEngine
 from mmlspark_tpu.serve.handoff import HandoffBus
 from mmlspark_tpu.serve.lifecycle import (serve_forever, start_engine,
                                           start_http, start_router)
+from mmlspark_tpu.serve.prefix_cache import PrefixCache, PrefixHit
 from mmlspark_tpu.serve.replica import Replica, ReplicaUnavailable
 from mmlspark_tpu.serve.request import Request
 from mmlspark_tpu.serve.router import (RetryBudget, Router, RouterConfig,
@@ -46,7 +51,8 @@ from mmlspark_tpu.serve.router import (RetryBudget, Router, RouterConfig,
 __all__ = [
     "AdmissionController", "HandoffBus", "InvalidRequest",
     "MissRateBreaker",
-    "Overloaded", "Replica", "ReplicaUnavailable", "Request",
+    "Overloaded", "PrefixCache", "PrefixHit", "Replica",
+    "ReplicaUnavailable", "Request",
     "RetryBudget", "Router", "RouterConfig", "RouterRequest",
     "ServeConfig", "ServingEngine", "StepTimeEstimator", "build_fleet",
     "serve_forever", "start_engine", "start_http", "start_router",

@@ -38,7 +38,8 @@ from mmlspark_tpu.observe.trace import trace_event
 from mmlspark_tpu.resilience.breaker import CLOSED, CircuitBreaker, \
     CircuitOpenError
 from mmlspark_tpu.resilience.clock import Clock, get_clock
-from mmlspark_tpu.serve.request import Request
+from mmlspark_tpu.serve.request import (BATCH, INTERACTIVE, PRIORITIES,
+                                        Request)
 
 
 class Overloaded(RuntimeError):
@@ -225,14 +226,29 @@ class AdmissionController:
     def __init__(self, capacity: int, estimator: StepTimeEstimator,
                  breaker: Optional[MissRateBreaker] = None, *,
                  max_batch: int = 1, degraded_available: bool = False,
+                 batch_share: float = 1.0,
                  clock: Optional[Clock] = None):
         if capacity < 1:
             raise ValueError(f"queue capacity must be >= 1, got {capacity}")
+        if not 0.0 < batch_share <= 1.0:
+            raise ValueError(
+                f"batch_share must be in (0, 1], got {batch_share}")
         self.capacity = int(capacity)
         self.estimator = estimator
         self.breaker = breaker
         self.max_batch = max(1, int(max_batch))
         self.degraded_available = bool(degraded_available)
+        # weighted shedding: the batch lane may hold at most
+        # ceil(capacity * batch_share) queue slots, and a full queue
+        # displaces its NEWEST batch request to seat an interactive
+        # arrival — overload costs the batch tier first, in both
+        # directions (docs/serving.md "Prefix reuse & priority lanes")
+        self.batch_share = float(batch_share)
+        self._displaced: list[Request] = []
+        # incrementally-maintained count of queued batch-lane requests:
+        # interactive-only traffic (the common case) must not pay
+        # per-call queue scans for lane bookkeeping it never uses
+        self._batch_queued = 0
         self._clock = clock
         self._queue: collections.deque = collections.deque()
         self._lock = threading.Lock()
@@ -261,6 +277,8 @@ class AdmissionController:
         be preserved either way."""
         with self._lock:
             self._queue.appendleft(req)
+            if getattr(req, "priority", INTERACTIVE) == BATCH:
+                self._batch_queued += 1
 
     def remove(self, req: Request) -> bool:
         """Withdraw one still-queued request (a router cancelling the
@@ -269,6 +287,10 @@ class AdmissionController:
         with self._lock:
             try:
                 self._queue.remove(req)
+                if (self._batch_queued
+                        and getattr(req, "priority",
+                                    INTERACTIVE) == BATCH):
+                    self._batch_queued -= 1
                 return True
             except ValueError:
                 return False
@@ -282,19 +304,44 @@ class AdmissionController:
             return sum(r.max_new_tokens for r in self._queue)
 
     def take(self, bucket: int, n: int, lane: str = "primary") -> list:
-        """Pop up to `n` queued requests for `bucket` on `lane`, FIFO."""
+        """Pop up to `n` queued requests for `bucket` on `lane`:
+        interactive priority first, then batch, FIFO within each — a
+        queued batch request never rides ahead of a waiting interactive
+        one in its own bucket."""
         out: list[Request] = []
         with self._lock:
-            keep: collections.deque = collections.deque()
-            while self._queue and len(out) < n:
-                req = self._queue.popleft()
-                want = "degraded" if req.degraded else "primary"
-                if req.bucket == bucket and want == lane:
-                    out.append(req)
-                else:
-                    keep.append(req)
-            keep.extend(self._queue)
-            self._queue = keep
+            if not self._batch_queued:
+                # fast path: no batch work queued, lane order is plain
+                # FIFO — one pass, no per-request priority reads
+                keep: collections.deque = collections.deque()
+                while self._queue and len(out) < n:
+                    req = self._queue.popleft()
+                    want = "degraded" if req.degraded else "primary"
+                    if req.bucket == bucket and want == lane:
+                        out.append(req)
+                    else:
+                        keep.append(req)
+                keep.extend(self._queue)
+                self._queue = keep
+                return out
+            for want_pri in PRIORITIES:
+                if len(out) >= n:
+                    break
+                keep = collections.deque()
+                while self._queue and len(out) < n:
+                    req = self._queue.popleft()
+                    want = "degraded" if req.degraded else "primary"
+                    if (req.bucket == bucket and want == lane
+                            and getattr(req, "priority",
+                                        INTERACTIVE) == want_pri):
+                        out.append(req)
+                    else:
+                        keep.append(req)
+                keep.extend(self._queue)
+                self._queue = keep
+            self._batch_queued -= sum(
+                1 for r in out
+                if getattr(r, "priority", INTERACTIVE) == BATCH)
         return out
 
     def queued_buckets(self) -> list:
@@ -307,6 +354,15 @@ class AdmissionController:
                     (req.bucket, "degraded" if req.degraded else "primary"))
         return list(seen)
 
+    def drain_displaced(self) -> list:
+        """Collect batch requests a full queue displaced for interactive
+        arrivals since the last call; the caller owns finishing them
+        (the engine cancels them WITHOUT feeding the miss breaker — a
+        displacement is a policy decision, not a deadline pathology)."""
+        with self._lock:
+            out, self._displaced = self._displaced, []
+        return out
+
     def drop_expired(self, now: float) -> list:
         """Remove queued requests whose deadline already passed (they
         would be cancelled the moment they reached a group anyway);
@@ -317,6 +373,10 @@ class AdmissionController:
             for req in self._queue:
                 (expired if req.deadline <= now else alive).append(req)
             self._queue = alive
+            if expired and self._batch_queued:
+                self._batch_queued -= sum(
+                    1 for r in expired
+                    if getattr(r, "priority", INTERACTIVE) == BATCH)
         return expired
 
     # -- front-end side ---------------------------------------------------
@@ -344,10 +404,44 @@ class AdmissionController:
                         request=req.id)
             raise Overloaded("draining", self.drain_hint_s,
                              "engine is draining")
+        pri = getattr(req, "priority", INTERACTIVE)
         with self._lock:
             depth = len(self._queue)
             backlog = sum(r.max_new_tokens for r in self._queue)
-        if depth >= self.capacity:
+            batch_depth = self._batch_queued
+            # an interactive arrival's wait does not include queued BATCH
+            # work — `take` serves it first, so pricing it against the
+            # batch backlog would manufacture infeasible rejections for
+            # exactly the traffic the lanes exist to protect
+            backlog_ahead = (backlog if pri == BATCH or not batch_depth
+                             else
+                             sum(r.max_new_tokens for r in self._queue
+                                 if getattr(r, "priority",
+                                            INTERACTIVE) == INTERACTIVE))
+            displaced = None
+            if (depth >= self.capacity and pri == INTERACTIVE
+                    and batch_depth):
+                # weighted shedding, eviction side: a full queue seats an
+                # interactive arrival by displacing its NEWEST queued
+                # batch request (the engine finishes it as cancelled)
+                for queued in reversed(self._queue):
+                    if getattr(queued, "priority",
+                               INTERACTIVE) == BATCH:
+                        displaced = queued
+                        break
+                if displaced is not None:
+                    self._queue.remove(displaced)
+                    self._displaced.append(displaced)
+                    self._batch_queued -= 1
+                    backlog -= displaced.max_new_tokens
+                    depth -= 1
+        if displaced is not None:
+            inc_counter("serve.displaced")
+            trace_event("serve.displaced", cat="serve",
+                        request=displaced.id, by=req.id)
+        batch_cap = max(1, int(self.capacity * self.batch_share))
+        if depth >= self.capacity or (pri == BATCH
+                                      and batch_depth >= batch_cap):
             # Retry-After derived from evidence, not a constant: the
             # backlog's estimated drain time, floored by the breaker's
             # own cooldown when it is open too
@@ -357,19 +451,21 @@ class AdmissionController:
                 hint = max(hint, self.breaker.retry_in_s())
             inc_counter("serve.shed")
             trace_event("serve.shed", cat="serve", reason="queue_full",
-                        request=req.id, depth=depth)
-            raise Overloaded("queue_full", hint,
-                             f"queue at capacity ({depth})")
+                        request=req.id, depth=depth, priority=pri)
+            detail = (f"batch lane at share cap ({batch_depth}/"
+                      f"{batch_cap})" if depth < self.capacity
+                      else f"queue at capacity ({depth})")
+            raise Overloaded("queue_full", hint, detail)
         # deadline feasibility: reject only on PROOF (estimates exist and
         # the earliest completion still lands past the deadline)
         service = self.estimator.service_s(req.bucket, req.max_new_tokens)
-        wait = self._queue_wait_s(backlog + in_flight_tokens)
+        wait = self._queue_wait_s(backlog_ahead + in_flight_tokens)
         if service is not None and wait is not None:
             earliest = now + wait + service
             if earliest > req.deadline:
                 inc_counter("serve.shed")
                 trace_event("serve.shed", cat="serve", reason="infeasible",
-                            request=req.id,
+                            request=req.id, priority=pri,
                             needed_s=round(wait + service, 4),
                             budget_s=round(req.deadline - now, 4))
                 raise Overloaded(
@@ -396,5 +492,7 @@ class AdmissionController:
                 raise Overloaded("draining", self.drain_hint_s,
                                  "engine is draining")
             self._queue.append(req)
+            if pri == BATCH:
+                self._batch_queued += 1
         inc_counter("serve.admitted")
         return lane

@@ -57,8 +57,9 @@ from mmlspark_tpu.resilience.clock import Clock, get_clock
 from mmlspark_tpu.serve.admission import (AdmissionController,
                                           InvalidRequest, MissRateBreaker,
                                           Overloaded, StepTimeEstimator)
-from mmlspark_tpu.serve.request import (CANCELLED, HANDOFF, OK, TIMEOUT,
-                                        Request)
+from mmlspark_tpu.serve.prefix_cache import PrefixCache
+from mmlspark_tpu.serve.request import (CANCELLED, HANDOFF, INTERACTIVE,
+                                        OK, PRIORITIES, TIMEOUT, Request)
 
 SERVE_QUEUE_CAPACITY = config.register(
     "MMLSPARK_TPU_SERVE_QUEUE_CAPACITY", 64,
@@ -114,6 +115,29 @@ SERVE_CACHE_DTYPE = config.register(
     "symmetric quantize-on-write; on a disaggregated fleet int8 pages "
     "also halve the handoff wire bytes)", ptype=str)
 
+SERVE_PREFIX_CACHE = config.register(
+    "MMLSPARK_TPU_SERVE_PREFIX_CACHE", False,
+    "serving: cross-request radix prefix KV cache — finished prefill "
+    "rows stay resident at cache_chunk granularity and later requests "
+    "sharing a chunk-aligned prompt prefix splice them in, prefilling "
+    "only the novel suffix (decode/colocated roles only; greedy outputs "
+    "stay byte-identical at model dtype)", ptype=bool)
+SERVE_PREFIX_MAX_ROWS = config.register(
+    "MMLSPARK_TPU_SERVE_PREFIX_MAX_ROWS", 64,
+    "serving: prefix-pool LRU budget in resident CHUNK rows (one row = "
+    "one cache_chunk of KV slots); leased rows never evict", ptype=int)
+SERVE_PREFIX_MAX_MB = config.register(
+    "MMLSPARK_TPU_SERVE_PREFIX_MAX_MB", 256.0,
+    "serving: prefix-pool LRU budget in resident megabytes (int8 KV "
+    "rows fit ~4x more prefixes per MB than model-dtype)", ptype=float)
+SERVE_LANE_BATCH_SHARE = config.register(
+    "MMLSPARK_TPU_SERVE_LANE_BATCH_SHARE", 0.5,
+    "serving: greatest fraction of the admission queue the BATCH "
+    "priority lane may hold; beyond it batch arrivals shed queue_full "
+    "while interactive traffic still seats (and a full queue displaces "
+    "its newest batch request for an interactive arrival) — overload "
+    "costs the batch tier first", ptype=float)
+
 _ROLES = ("colocated", "prefill", "decode")
 
 
@@ -147,6 +171,10 @@ class ServeConfig:
     spec_tokens: Optional[int] = None    # speculative draft depth (0 = off)
     role: Optional[str] = None           # colocated | prefill | decode
     cache_dtype: Optional[str] = None    # model | int8 resident KV cache
+    prefix_cache: Optional[bool] = None  # cross-request prefix KV reuse
+    prefix_max_rows: Optional[int] = None   # pool LRU budget, chunk rows
+    prefix_max_mb: Optional[float] = None   # pool LRU budget, megabytes
+    lane_batch_share: Optional[float] = None  # batch lane's queue share
 
     def __post_init__(self):
         read = lambda explicit, var, cast: cast(
@@ -167,6 +195,22 @@ class ServeConfig:
         self.prefill_chunk = read(self.prefill_chunk,
                                   SERVE_PREFILL_CHUNK, int)
         self.spec_tokens = read(self.spec_tokens, SERVE_SPEC_TOKENS, int)
+        self.prefix_cache = read(self.prefix_cache,
+                                 SERVE_PREFIX_CACHE, bool)
+        self.prefix_max_rows = read(self.prefix_max_rows,
+                                    SERVE_PREFIX_MAX_ROWS, int)
+        self.prefix_max_mb = read(self.prefix_max_mb,
+                                  SERVE_PREFIX_MAX_MB, float)
+        self.lane_batch_share = read(self.lane_batch_share,
+                                     SERVE_LANE_BATCH_SHARE, float)
+        if self.prefix_max_rows < 1:
+            raise ValueError("prefix_max_rows must be >= 1")
+        if self.prefix_max_mb <= 0:
+            raise ValueError("prefix_max_mb must be > 0")
+        if not 0.0 < self.lane_batch_share <= 1.0:
+            raise ValueError(
+                f"lane_batch_share must be in (0, 1], "
+                f"got {self.lane_batch_share}")
         if self.prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0")
         if self.spec_tokens < 0:
@@ -184,6 +228,16 @@ class ServeConfig:
             raise ValueError(
                 "speculative decoding is colocated-only: a "
                 f"role={self.role!r} tier cannot run spec_tokens > 0")
+        if self.role == "prefill" and self.prefix_cache:
+            # disaggregated tiers must not double-cache: the pool lives
+            # where decode does (build_fleet keeps it off the prefill
+            # tier; its finished rows ship over the handoff bus and the
+            # DECODE replica pools them)
+            raise ValueError(
+                "prefix_cache is decode/colocated-only: a role='prefill' "
+                "replica ships finished KV rows over the handoff bus and "
+                "must not keep a second resident copy — enable the pool "
+                "on the decode tier instead")
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if self.segment_steps < 1:
@@ -238,6 +292,18 @@ class _Group:
 CREATED, READY, DRAINING, STOPPED = "created", "ready", "draining", "stopped"
 
 
+def _assemble_prefix_row(chunks: list) -> list:
+    """Concatenate a prefix hit's per-chunk pool payloads back into one
+    cache row (slot axis 1), layer by layer — both cache layouts ride
+    through (2-tuple model-dtype, 4-tuple int8 with its scale arrays)."""
+    import jax.numpy as jnp
+    row = []
+    for layer_parts in zip(*chunks):
+        row.append(tuple(jnp.concatenate(ts, axis=1)
+                         for ts in zip(*layer_parts)))
+    return row
+
+
 class ServingEngine:
     """In-process serving over a model bundle (module docstring).
 
@@ -289,7 +355,14 @@ class ServingEngine:
         self.admission = AdmissionController(
             self.cfg.queue_capacity, self.estimator, self.breaker,
             max_batch=self.cfg.max_batch,
-            degraded_available=degraded_bundle is not None, clock=clock)
+            degraded_available=degraded_bundle is not None,
+            batch_share=self.cfg.lane_batch_share, clock=clock)
+        # cross-request prefix pool: primary-lane rows only (degraded
+        # lanes decode different weights — their caches never mix)
+        self._prefix = (PrefixCache(
+            self.cfg.cache_chunk, max_rows=self.cfg.prefix_max_rows,
+            max_bytes=int(self.cfg.prefix_max_mb * 2 ** 20))
+            if self.cfg.prefix_cache else None)
         self._groups: dict[tuple, _Group] = {}
         # in-flight chunked prefills: one advances a single chunk per
         # tick, between phase 4 (joins) and phase 5 (segments)
@@ -574,16 +647,24 @@ class ServingEngine:
         return arr
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               priority: Optional[str] = None) -> Request:
         """Admit one request or raise (`InvalidRequest` for poison,
-        `Overloaded` when shed).  Returns the live `Request`; callers
-        block on `request.wait()` or poll `request.finished`."""
+        `Overloaded` when shed).  `priority` picks the admission lane
+        ('interactive', the default, or 'batch' — weighted shedding
+        costs the batch lane first under overload).  Returns the live
+        `Request`; callers block on `request.wait()` or poll
+        `request.finished`."""
         if not self.alive:
             self._count("shed_draining")
             self._count("shed")
             self._record_serve({"event": "shed", "reason": "draining"})
             raise Overloaded("draining", self.retry_after_s(),
                              f"engine is {self._state}")
+        pri = str(priority) if priority is not None else INTERACTIVE
+        if pri not in PRIORITIES:
+            raise InvalidRequest(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
         n_new = int(max_new_tokens if max_new_tokens is not None
                     else self.cfg.max_new_tokens)
         arr = self._validate(prompt, n_new)
@@ -595,15 +676,30 @@ class ServingEngine:
         now = self.now()
         deadline = now + (float(deadline_s) if deadline_s is not None
                           else self.cfg.default_deadline_s)
-        req = Request(self._new_id(), arr, bucket, n_new, now, deadline)
+        req = Request(self._new_id(), arr, bucket, n_new, now, deadline,
+                      priority=pri)
         try:
             self.admission.try_admit(req, self.in_flight_tokens())
         except Overloaded as e:
             self._count(f"shed_{e.reason}")
             self._count("shed")
             self._record_serve({"event": "shed", "reason": e.reason,
-                               "request": req.id})
+                               "request": req.id, "priority": pri})
             raise
+        finally:
+            # a full queue seats an interactive arrival by displacing
+            # its newest queued BATCH request: finish the displaced ones
+            # here, WITHOUT feeding the miss breaker (displacement is
+            # weighted-shedding policy, not a deadline pathology)
+            for d in self.admission.drain_displaced():
+                d.finish(CANCELLED, now,
+                         "displaced by interactive arrival")
+                self._count("displaced")
+                self._count("shed")
+                self._record_serve({
+                    "event": "shed", "reason": "displaced",
+                    "request": d.id,
+                    "priority": getattr(d, "priority", INTERACTIVE)})
         self._count("admitted")
         if req.degraded:
             self._count("degraded")
@@ -627,6 +723,21 @@ class ServingEngine:
     def _record_serve(self, event: dict) -> None:
         if self._run is not None:
             self._run.record_serve(event)
+
+    def _record_prefix(self, event: dict) -> None:
+        if self._run is not None:
+            self._run.record_prefix(event)
+
+    def _gauge_prefix(self) -> None:
+        # mmlspark_tpu_prefix_{hit_rate,resident_rows,resident_bytes,
+        # evictions} on the Prometheus surface (observe/export.py)
+        if self._run is None or self._prefix is None:
+            return
+        s = self._prefix.stats()
+        self._run.gauge("prefix.hit_rate", round(s["hit_rate"], 4))
+        self._run.gauge("prefix.resident_rows", s["resident_rows"])
+        self._run.gauge("prefix.resident_bytes", s["resident_bytes"])
+        self._run.gauge("prefix.evictions", s["evictions"])
 
     def retry_after_s(self) -> float:
         """The live backoff hint for refused/cancelled traffic: remaining
@@ -702,6 +813,15 @@ class ServingEngine:
         req.finish(status, now, detail)
         missed = status != OK or now > req.deadline
         self.breaker.record(missed)
+        # the per-request terminal record the strict-priority drill
+        # asserts lane outcomes against (zero interactive misses while
+        # batch sheds); gated so the no-telemetry hot path never builds
+        # the dict
+        if self._run is not None:
+            self._record_serve({
+                "event": "finish", "request": req.id, "status": status,
+                "priority": getattr(req, "priority", INTERACTIVE),
+                "deadline_miss": bool(missed)})
         self._count("finished")
         self._count(status)
         if status == OK:
@@ -751,6 +871,7 @@ class ServingEngine:
                 for req in job["reqs"]:
                     self._complete(req, CANCELLED, "drain timeout")
                     worked = True
+                self._release_job_lease(job)
             self._pending.clear()
             for req in self.admission.drop_expired(float("inf")):
                 self._complete(req, CANCELLED, "drain timeout")
@@ -778,11 +899,18 @@ class ServingEngine:
                 continue
             reqs = self.admission.take(bucket, len(free), lane)
             if reqs:
-                if self._engines[lane].serve_prefill_chunks(bucket):
-                    self._start_chunked_join(g, lane, reqs,
-                                             free[:len(reqs)])
-                else:
-                    self._join(g, lane, reqs, free[:len(reqs)])
+                slots = free[:len(reqs)]
+                if self._prefix is not None and lane == "primary":
+                    # peel prefix-pool hits off the cohort: each resumes
+                    # from its donor rows (only the novel suffix
+                    # prefills); misses keep the normal cohort path
+                    reqs, slots = self._join_prefix_hits(g, lane, reqs,
+                                                         slots)
+                if reqs:
+                    if self._engines[lane].serve_prefill_chunks(bucket):
+                        self._start_chunked_join(g, lane, reqs, slots)
+                    else:
+                        self._join(g, lane, reqs, slots)
                 worked = True
         # 4b. advance every in-flight chunked prefill by ONE chunk — the
         # point of chunking: the long forward yields to phase 5 between
@@ -834,6 +962,101 @@ class ServingEngine:
         self.estimator.observe_prefill(g.bucket, monotonic() - t0)
         self._splice(g, lane, reqs, slots, list(range(len(reqs))),
                      tok_h, caches, prompts)
+
+    def _join_prefix_hits(self, g: _Group, lane: str, reqs: list,
+                          slots: list) -> tuple:
+        """Try each join candidate against the prefix pool.  Hits resume
+        from their donor rows — inline, or as a pending chunked-resume
+        job when chunked prefill covers the suffix — and misses return
+        for the normal cohort path.  The donor lease holds until the
+        hit's splice lands (lease pinning: an in-flight resume can never
+        lose its slots to eviction)."""
+        eng = self._engines[lane]
+        miss_reqs, miss_slots = [], []
+        for req, slot in zip(reqs, slots):
+            # match only whole chunks STRICTLY inside the prompt, so the
+            # resumed prefill always recomputes the last prompt
+            # position's logits itself
+            limit = ((req.true_len - 1) // self._prefix.chunk
+                     ) * self._prefix.chunk
+            hit = (self._prefix.acquire(req.prompt, limit)
+                   if limit else None)
+            if hit is None:
+                miss_reqs.append(req)
+                miss_slots.append(slot)
+                continue
+            matched = hit.n_tokens
+            self._count("prefix_hits")
+            inc_counter("serve.prefix_hit")
+            self._record_prefix({
+                "event": "hit", "request": req.id, "bucket": g.bucket,
+                "lane": lane, "matched": matched,
+                "suffix": int(req.true_len) - matched})
+            if eng.serve_resume_chunks(g.bucket, matched):
+                self._start_chunked_resume(g, lane, req, slot, hit)
+            else:
+                self._join_resume(g, lane, req, slot, hit)
+        return miss_reqs, miss_slots
+
+    def _join_resume(self, g: _Group, lane: str, req: Request, slot: int,
+                     hit) -> None:
+        """Resume one prefix hit inline: dequantize/grow the donor rows,
+        prefill the whole novel suffix in one traced-offset chunk call,
+        finish, and splice — the same (tok, done, caches) contract as a
+        fresh cohort prefill, so greedy outputs stay byte-identical."""
+        eng = self._engines[lane]
+        variables = self._variables[lane]
+        matched = hit.n_tokens
+        prompts = np.zeros((1, g.bucket), np.int32)
+        prompts[0, :req.true_len] = req.prompt
+        true_len = np.asarray([req.true_len], np.int32)
+        ids = np.asarray([req.id], np.int32)
+        t0 = monotonic()
+        try:
+            with span_on_tracer(self._tracer, "serve.prefill_resume",
+                                cat="serve", bucket=g.bucket, lane=lane,
+                                matched=matched,
+                                suffix=int(req.true_len) - matched):
+                tok, done, caches = eng.serve_prefill_resume(
+                    variables, prompts, true_len, matched,
+                    _assemble_prefix_row(hit.rows), np.ones(1, bool),
+                    self._row_keys(ids))
+                tok_h = np.asarray(tok)
+            self.estimator.observe_prefill(g.bucket, monotonic() - t0)
+            self._splice(g, lane, [req], [slot], [0], tok_h, caches,
+                         prompts)
+        finally:
+            self._prefix.release(hit)
+
+    def _start_chunked_resume(self, g: _Group, lane: str, req: Request,
+                              slot: int, hit) -> None:
+        """Queue a chunked RESUME: like `_start_chunked_join`, but the
+        state opens from the donor rows and the chunk index starts past
+        the matched prefix — `_advance_prefill` then runs the suffix one
+        chunk per tick through the ordinary prefill_chunk program.  The
+        donor lease holds across ticks until the splice."""
+        eng = self._engines[lane]
+        matched = hit.n_tokens
+        prompts = np.zeros((1, g.bucket), np.int32)
+        prompts[0, :req.true_len] = req.prompt
+        g.reserved.add(slot)
+        state = eng.serve_resume_init(_assemble_prefix_row(hit.rows),
+                                      g.bucket)
+        self._pending.append(dict(
+            group=g, lane=lane, reqs=[req], slots=[slot],
+            prompts=prompts,
+            true_len=np.asarray([req.true_len], np.int32),
+            live=np.ones(1, bool),
+            ids=np.asarray([req.id], np.int32), state=state,
+            index=matched // eng.prefill_chunk,
+            chunks=eng.serve_prefill_chunks(g.bucket), elapsed=0.0,
+            hit=hit))
+
+    def _release_job_lease(self, job: dict) -> None:
+        hit = job.get("hit")
+        if hit is not None and self._prefix is not None:
+            self._prefix.release(hit)
+            job["hit"] = None
 
     def _start_chunked_join(self, g: _Group, lane: str, reqs: list,
                             slots: list) -> None:
@@ -896,6 +1119,7 @@ class ServingEngine:
         if reqs:
             self._splice(g, lane, reqs, slots, src, tok_h, caches,
                          job["prompts"])
+        self._release_job_lease(job)
 
     def _splice(self, g: _Group, lane: str, reqs: list, slots: list,
                 src: list, tok_h, caches, prompts) -> None:
@@ -945,6 +1169,38 @@ class ServingEngine:
                                 "bucket": g.bucket, "slot": slot,
                                 "lane": lane})
             self._emit(g, slot, [int(tok_h[j])])
+        if self._prefix is not None and lane == "primary":
+            self._insert_prefix_rows(reqs, src, caches)
+            self._gauge_prefix()
+
+    def _insert_prefix_rows(self, reqs: list, src: list, caches) -> None:
+        """Pool each freshly spliced request's prompt-prefix slots: the
+        greatest chunk multiple STRICTLY inside the prompt, so a later
+        resume always recomputes the final prompt position itself.
+        First-writer-wins per chunk; a refused eviction (every candidate
+        leased) skips the deeper chunks rather than forcing anything."""
+        chunk = self._prefix.chunk
+        for j, req in zip(src, reqs):
+            n = ((req.true_len - 1) // chunk) * chunk
+            if n < chunk:
+                continue
+            row = [tuple(t[j:j + 1] for t in layer) for layer in caches]
+            res = self._prefix.insert(req.prompt, n, row)
+            if res["inserted"]:
+                self._count("prefix_inserts", res["inserted"])
+                self._record_prefix({
+                    "event": "insert", "request": req.id,
+                    "chunks": res["inserted"], "tokens": n})
+            if res["evicted"]:
+                self._count("prefix_evictions", res["evicted"])
+                self._record_prefix({
+                    "event": "evict", "chunks": res["evicted"],
+                    "request": req.id})
+            if res["refused"]:
+                self._count("prefix_evictions_refused")
+                inc_counter("serve.prefix_eviction_refused")
+                self._record_prefix({"event": "evict_refused",
+                                     "request": req.id})
 
     def _empty_caches(self, module, capacity: int, bucket: int,
                       kind: str = "model") -> list:
@@ -1008,6 +1264,13 @@ class ServingEngine:
         self._record_serve({"event": "remote_join", "request": req.id,
                             "bucket": bucket, "slot": slot, "lane": lane})
         self._emit(g, slot, [int(first_tok)])
+        if self._prefix is not None and lane == "primary":
+            # the pool lives on the DECODE tier of a disaggregated
+            # fleet: handed-off rows are the tier's only prefill source,
+            # so they are what populates it (the prefill tier never
+            # double-caches — ServeConfig rejects prefix_cache there)
+            self._insert_prefix_rows([req], [0], src_caches)
+            self._gauge_prefix()
         return req
 
     def _emit(self, g: _Group, slot: int, tokens: list) -> None:
@@ -1071,6 +1334,7 @@ class ServingEngine:
         if self._run is not None:
             self._run.gauge("serve.queue_depth", self.admission.pending())
             self._run.gauge("serve.in_flight", self.in_flight())
+            self._gauge_prefix()
 
     def _advance_spec(self, g: _Group, lane: str) -> None:
         """One speculative round: the draft proposes, one target forward
@@ -1167,10 +1431,17 @@ class ServingEngine:
         out["queued"] = self.admission.pending()
         out["state"] = self._state
         out["breaker_state"] = self.breaker.state
+        if self._prefix is not None:
+            out["prefix"] = self._prefix.stats()
         for name, q in (("p50", 50), ("p95", 95), ("p99", 99)):
             p = self._percentile(q)
             out[f"latency_{name}_s"] = round(p, 6) if p is not None else None
         return out
+
+    def prefix_stats(self) -> Optional[dict]:
+        """The prefix pool's live stats dict (None when the pool is off)
+        — surfaced per replica in `Replica.health()` and `/statz`."""
+        return self._prefix.stats() if self._prefix is not None else None
 
     def _gauge_stats(self) -> None:
         if self._run is None:

@@ -137,7 +137,8 @@ def make_handler(engine):
                     prompt,
                     max_new_tokens=body.get("max_new_tokens"),
                     deadline_s=(float(deadline_ms) / 1e3
-                                if deadline_ms is not None else None))
+                                if deadline_ms is not None else None),
+                    priority=body.get("priority"))
             except InvalidRequest as e:
                 self._json(400, {"error": str(e)})
                 return

@@ -182,7 +182,8 @@ class Replica:
 
     # -- submission / scheduling ------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               priority: Optional[str] = None) -> Request:
         """Route one attempt to this replica's engine; raises
         `ReplicaUnavailable` when the handle knows the engine is dead
         (crashed/hung) — the router records it as a dispatch failure."""
@@ -192,7 +193,7 @@ class Replica:
         if self._hung:
             raise ReplicaUnavailable(f"replica {self.name} is hung")
         return self.engine.submit(prompt, max_new_tokens,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s, priority=priority)
 
     def tick(self) -> bool:
         """Advance the engine one scheduler pass, honoring injected
@@ -228,6 +229,7 @@ class Replica:
         # mid-chunked-prefill cohorts hold only RESERVED slots — their
         # requests live in the pending-job list, not in any row
         for job in list(self.engine._pending):
+            self.engine._release_job_lease(job)
             for req in job["reqs"]:
                 if not req.finished:
                     req.finish(ERROR, now, detail)
@@ -298,7 +300,9 @@ class Replica:
 
     def health(self) -> dict:
         """Point-in-time health for /statz, gauges, and the drills."""
+        prefix = self.engine.prefix_stats()
         return {"state": self.engine.state,
+                **({"prefix": prefix} if prefix is not None else {}),
                 "ready": self.engine.ready,
                 "role": self.role,
                 "draining": self.draining,

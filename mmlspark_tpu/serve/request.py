@@ -39,17 +39,23 @@ OK, TIMEOUT, CANCELLED, ERROR = "ok", "timeout", "cancelled", "error"
 # open until the decode attempt finishes (serve/handoff.py owns it)
 HANDOFF = "handoff"
 
+# priority lanes (admission weighted shedding: overload costs the batch
+# lane first — docs/serving.md "Prefix reuse & priority lanes")
+INTERACTIVE, BATCH = "interactive", "batch"
+PRIORITIES = (INTERACTIVE, BATCH)
+
 
 class Request:
     """One admitted generation request (see module docstring)."""
 
     __slots__ = ("id", "prompt", "true_len", "bucket", "max_new_tokens",
-                 "arrival", "deadline", "degraded", "tokens", "status",
-                 "detail", "finished_at", "span", "_event", "_progress",
-                 "listener")
+                 "arrival", "deadline", "priority", "degraded", "tokens",
+                 "status", "detail", "finished_at", "span", "_event",
+                 "_progress", "listener")
 
     def __init__(self, req_id: int, prompt: np.ndarray, bucket: int,
-                 max_new_tokens: int, arrival: float, deadline: float):
+                 max_new_tokens: int, arrival: float, deadline: float,
+                 priority: str = INTERACTIVE):
         self.id = req_id
         self.prompt = prompt                  # (true_len,) int32
         self.true_len = int(prompt.shape[0])
@@ -57,6 +63,7 @@ class Request:
         self.max_new_tokens = int(max_new_tokens)
         self.arrival = float(arrival)
         self.deadline = float(deadline)
+        self.priority = priority              # interactive | batch lane
         self.degraded = False
         self.tokens: list[int] = []           # emitted generation so far
         self.status: Optional[str] = None     # terminal status, None = open

@@ -66,8 +66,10 @@ from mmlspark_tpu.serve.engine import (CREATED, DRAINING, READY, STOPPED,
                                        SERVE_QUEUE_CAPACITY, ServeConfig,
                                        ServingEngine)
 from mmlspark_tpu.serve.handoff import HandoffBus
+from mmlspark_tpu.serve.prefix_cache import PrefixCache
 from mmlspark_tpu.serve.replica import Replica, ReplicaUnavailable
-from mmlspark_tpu.serve.request import CANCELLED, HANDOFF, OK, TIMEOUT
+from mmlspark_tpu.serve.request import (CANCELLED, HANDOFF, INTERACTIVE,
+                                        OK, PRIORITIES, TIMEOUT)
 
 SERVE_REPLICAS = config.register(
     "MMLSPARK_TPU_SERVE_REPLICAS", 2,
@@ -121,6 +123,14 @@ SERVE_HANDOFF_PAGES_PER_TICK = config.register(
     "disaggregated fleet: KV pages pushed per transfer per router tick "
     "— the pipelining knob that overlaps transfer with prefill compute",
     ptype=int)
+SERVE_PREFIX_AFFINITY = config.register(
+    "MMLSPARK_TPU_SERVE_PREFIX_AFFINITY", True,
+    "serving fleet: steer requests sharing a first cache chunk to the "
+    "same replica (hash-of-prefix affinity) so radix prefix-cache hits "
+    "concentrate instead of spreading; falls back to power-of-two-"
+    "choices when the target is ejected.  Only active on colocated "
+    "fleets whose engines enable MMLSPARK_TPU_SERVE_PREFIX_CACHE",
+    ptype=bool)
 
 # the router-only terminal status: a failed request the retry budget
 # would not let us place again (HTTP 429 + Retry-After)
@@ -155,6 +165,8 @@ class RouterConfig:
     decode_replicas: Optional[int] = None
     handoff_timeout_s: Optional[float] = None
     handoff_pages_per_tick: Optional[int] = None
+    # hash-of-prefix replica affinity (colocated prefix-cache fleets)
+    prefix_affinity: Optional[bool] = None
 
     def __post_init__(self):
         read = lambda explicit, var, cast: cast(
@@ -189,6 +201,8 @@ class RouterConfig:
         self.handoff_pages_per_tick = read(self.handoff_pages_per_tick,
                                            SERVE_HANDOFF_PAGES_PER_TICK,
                                            int)
+        self.prefix_affinity = read(self.prefix_affinity,
+                                    SERVE_PREFIX_AFFINITY, bool)
         if (self.prefill_replicas > 0) != (self.decode_replicas > 0):
             raise ValueError(
                 "a disaggregated fleet needs BOTH prefill_replicas and "
@@ -270,12 +284,14 @@ class RouterRequest:
     (replica_name, engine Request) pairs, newest last."""
 
     __slots__ = ("id", "prompt", "true_len", "bucket", "max_new_tokens",
-                 "arrival", "deadline", "degraded", "tokens", "status",
-                 "detail", "finished_at", "retry_after_s", "attempts",
-                 "retries", "hedged", "span", "_event", "_progress")
+                 "arrival", "deadline", "priority", "degraded", "tokens",
+                 "status", "detail", "finished_at", "retry_after_s",
+                 "attempts", "retries", "hedged", "span", "_event",
+                 "_progress")
 
     def __init__(self, req_id: int, prompt: np.ndarray, bucket: int,
-                 max_new_tokens: int, arrival: float, deadline: float):
+                 max_new_tokens: int, arrival: float, deadline: float,
+                 priority: str = INTERACTIVE):
         self.id = req_id
         self.prompt = prompt
         self.true_len = int(prompt.shape[0])
@@ -283,6 +299,7 @@ class RouterRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.arrival = float(arrival)
         self.deadline = float(deadline)
+        self.priority = priority
         self.degraded = False
         self.tokens: list[int] = []
         self.status: Optional[str] = None
@@ -398,6 +415,18 @@ class Router:
             clock=clock)
         self.budget = RetryBudget(self.cfg.retry_budget_cap,
                                   self.cfg.retry_budget_per_s, clock=clock)
+        # fleet-aware prefix affinity: same first cache chunk → same
+        # replica, so shared prefixes concentrate their radix-cache hits
+        # instead of spreading across the pool.  The router only STEERS
+        # — correctness never depends on landing the affinity target,
+        # so an ejected target just falls back to power-of-two-choices.
+        # Tiered fleets dispatch to the prefill tier, which rejects the
+        # pool outright (satellite-6), so affinity stays colocated-only.
+        self._affinity = bool(self.cfg.prefix_affinity and not self.tiered
+                              and any(r.engine.cfg.prefix_cache
+                                      for r in self.replicas))
+        self._affinity_pool = sorted(self.replicas, key=lambda r: r.name)
+        self._affinity_chunk = self.replicas[0].engine.cfg.cache_chunk
         self._rng = random.Random(self.cfg.seed)
         self._live: list[RouterRequest] = []   # dispatched, not finished
         self._state = CREATED
@@ -523,11 +552,17 @@ class Router:
         return sum(r.load_tokens() for r in self.replicas)
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> RouterRequest:
+               deadline_s: Optional[float] = None,
+               priority: Optional[str] = None) -> RouterRequest:
         """Admit one request into the FLEET queue or raise
         (`InvalidRequest` / `Overloaded`); the scheduler places it on a
         replica at the next tick.  Shed reasons add `no_replica`: the
         whole fleet is ejected/faulted and not yet due a probe."""
+        pri = INTERACTIVE if priority is None else str(priority)
+        if pri not in PRIORITIES:
+            inc_counter("serve.poison")
+            raise InvalidRequest(
+                f"priority must be one of {PRIORITIES}, got {pri!r}")
         if not self.alive:
             self._count("shed_draining")
             self._count("shed")
@@ -546,7 +581,8 @@ class Router:
         now = self.now()
         deadline = now + (float(deadline_s) if deadline_s is not None
                           else self.cfg.default_deadline_s)
-        rr = RouterRequest(self._new_id(), arr, bucket, n_new, now, deadline)
+        rr = RouterRequest(self._new_id(), arr, bucket, n_new, now, deadline,
+                           priority=pri)
         # a tiered fleet needs BOTH tiers reachable: prefill to take the
         # dispatch, decode to take the handoff
         pools = ([self._prefill_reps, self._decode_reps] if self.tiered
@@ -565,6 +601,17 @@ class Router:
             self._count("shed")
             self._record_routing("shed", reason=e.reason, request=rr.id)
             raise
+        finally:
+            # an interactive arrival at a full queue displaces the
+            # newest queued batch request (weighted shedding: overload
+            # costs the batch lane first); finish the victim as SHED
+            # with a retry hint so its client backs off and resubmits
+            for d in self.admission.drain_displaced():
+                self._count("displaced")
+                self._record_routing("shed", reason="displaced",
+                                     request=d.id)
+                self._complete(d, SHED, "displaced by interactive arrival",
+                               retry_after=self.retry_after_s())
         self._count("admitted")
         with self._wake:
             self._wake.notify_all()
@@ -651,16 +698,44 @@ class Router:
                 return got[0]
         return None
 
-    def _candidates(self) -> list:
+    def _affinity_target(self, rr: Optional[RouterRequest]):
+        """The replica this request's first cache chunk hashes to, or
+        None when affinity is off / the prompt is shorter than one
+        chunk.  Pool order is sorted-by-name, so the mapping is stable
+        across router restarts and replica list permutations."""
+        if rr is None or not self._affinity:
+            return None
+        if rr.true_len < self._affinity_chunk:
+            return None
+        key = PrefixCache.affinity_key(rr.prompt, self._affinity_chunk)
+        return self._affinity_pool[int(key, 16) % len(self._affinity_pool)]
+
+    def _candidates(self, rr: Optional[RouterRequest] = None) -> list:
         """Dispatch preference: a due probe first (re-admission must not
-        starve behind healthy capacity), then the p2c pick, then the
-        remaining routable replicas by load."""
+        starve behind healthy capacity), then the affinity target when
+        its breaker allows it, then the p2c pick, then the remaining
+        routable replicas by load."""
         pool = self._prefill_reps if self.tiered else self.replicas
         order: list[Replica] = []
         probes = [r for r in pool if r.probe_due()]
         if probes:
             order.append(probes[0])
         healthy = [r for r in pool if r.routable()]
+        target = self._affinity_target(rr)
+        if target is not None and target in healthy:
+            self._count("affinity_routes")
+            self._record_routing("affinity", request=rr.id,
+                                 replica=target.name)
+            order.append(target)
+            order.extend(sorted((r for r in healthy if r is not target),
+                                key=lambda r: r.load_tokens()))
+            return order
+        if target is not None:
+            # the affinity target is ejected / faulted / full: fall back
+            # to power-of-two-choices rather than queueing behind it
+            self._count("affinity_fallback")
+            self._record_routing("affinity_fallback", request=rr.id,
+                                 replica=target.name)
         if len(healthy) >= 2:
             a, b = self._rng.sample(healthy, 2)
             pick = min((a, b), key=lambda r: r.load_tokens())
@@ -681,7 +756,8 @@ class Router:
                 return None
         try:
             att = rep.submit(rr.prompt, rr.max_new_tokens,
-                             deadline_s=max(1e-3, rr.deadline - now))
+                             deadline_s=max(1e-3, rr.deadline - now),
+                             priority=rr.priority)
         except (Overloaded, ReplicaUnavailable, InvalidRequest) as e:
             if probe:
                 # the gate was opened for us; a refused probe is a
@@ -716,7 +792,7 @@ class Router:
                 progressed = True
                 continue
             placed = False
-            for rep in self._candidates():
+            for rep in self._candidates(rr):
                 if self._try_dispatch(rr, rep, now) is not None:
                     placed = True
                     break
@@ -875,7 +951,8 @@ class Router:
             target = min(targets, key=lambda r: r.load_tokens())
             try:
                 att = target.submit(rr.prompt, rr.max_new_tokens,
-                                    deadline_s=remaining)
+                                    deadline_s=remaining,
+                                    priority=rr.priority)
             except (Overloaded, ReplicaUnavailable):
                 continue
             target.routed += 1
@@ -1068,8 +1145,12 @@ def build_fleet(bundle, n: Optional[int] = None, *,
         # disaggregated tiers: prefill pool p0..pN hands finished KV
         # rows over the bus to decode pool d0..dM
         for i in range(cfg.prefill_replicas):
+            # a prefill-tier replica ships its finished KV rows over the
+            # handoff bus — the prefix pool lives on the decode tier
+            # only, never double-cached (ServeConfig rejects the combo)
             replicas.append(make(
-                f"p{i}", dataclasses.replace(scfg, role="prefill")))
+                f"p{i}", dataclasses.replace(scfg, role="prefill",
+                                             prefix_cache=False)))
         for i in range(cfg.decode_replicas):
             replicas.append(make(
                 f"d{i}", dataclasses.replace(scfg, role="decode")))

@@ -2,10 +2,11 @@
 """Serving chaos drills: prove the engine sheds, degrades, and drains —
 never stalls, never corrupts.
 
-Five scenarios through the PR-7 `Scenario` DSL (resilience/chaos.py),
-each driving a REAL threaded ServingEngine (and, where the fault is a
+Seven scenarios through the PR-7 `Scenario` DSL (resilience/chaos.py),
+most driving a REAL threaded ServingEngine (and, where the fault is a
 client behavior, the real HTTP front end) with a scripted fault from the
-injector:
+injector; the two priority/prefix drills drive an inline engine tick by
+tick so queue and pool states are deterministic:
 
   burst_arrivals      a burst lands on a tiny queue: admission must shed
                       (429) instead of letting deadlines die in the
@@ -24,6 +25,19 @@ injector:
                       residents must keep their segment cadence between
                       chunk ticks (asserted from the run_summary serve
                       timeline), and every output stays byte-exact
+  strict_priority_overload
+                      overload a tiny queue with batch-lane traffic,
+                      then interactive arrivals: the batch lane sheds
+                      (share cap + displacement) while every
+                      interactive request completes byte-exact with
+                      zero deadline misses — weighted shedding costs
+                      batch first (asserted from the run_summary serve
+                      timeline too)
+  eviction_under_lease
+                      a full prefix pool must REFUSE to evict a row
+                      leased by an in-flight resume splice; the leasing
+                      request still completes byte-exact (asserted from
+                      the run_summary prefix timeline)
 
 Corruption check: greedy decode is deterministic, so each completed
 response must EXACTLY equal `DecodeEngine.generate`'s offline tokens for
@@ -391,6 +405,184 @@ def scenario_chunked_prefill(bundle):
     return run_scenario(scenario, run)
 
 
+def drain_inline(engine, requests, max_ticks=400):
+    """Tick an INLINE (un-threaded) engine until `requests` finish —
+    the deterministic harness the priority/prefix drills need, where
+    queue contents between submissions are part of the assertion."""
+    for _ in range(max_ticks):
+        if all(r.finished for r in requests):
+            return
+        engine._tick()
+    raise AssertionError(
+        f"requests not finished after {max_ticks} ticks: "
+        f"{[r.status for r in requests]}")
+
+
+def scenario_strict_priority(bundle):
+    """Batch traffic fills a tiny queue past its lane share, then
+    interactive arrivals land: the share cap sheds the excess batch
+    requests at the front door, the full queue displaces the queued
+    batch residents in favor of the interactive arrivals, and every
+    interactive request completes byte-exact within its deadline —
+    overload costs the batch lane first, never the interactive one."""
+    from mmlspark_tpu.resilience.chaos import Scenario, run_scenario
+
+    scenario = Scenario(
+        "strict_priority_overload",
+        expect={"interactive_ok": 4, "interactive_shed": 0,
+                "interactive_deadline_miss": 0, "min_batch_shed": 3,
+                "min_batch_displaced": 1, "corrupt": 0})
+
+    def run():
+        from mmlspark_tpu.serve import Overloaded
+
+        engine = make_engine(bundle, queue_capacity=4,
+                             lane_batch_share=0.5)
+        engine.warmup()
+        rng = np.random.default_rng(6)
+        batch_reqs, batch_shed = [], 0
+        # 6 batch arrivals against batch_cap = 4 * 0.5 = 2: two queue,
+        # four shed at the share cap (no ticks yet, so nothing drains)
+        for _ in range(6):
+            prompt = rng.integers(0, 64, (5,)).astype(np.int32)
+            try:
+                batch_reqs.append(engine.submit(
+                    prompt, max_new_tokens=8, deadline_s=60.0,
+                    priority="batch"))
+            except Overloaded:
+                batch_shed += 1
+        inter_reqs, inter_shed = [], 0
+        # 4 interactive arrivals: two fill the queue, two displace the
+        # queued batch requests (weighted shedding under overload)
+        for _ in range(4):
+            prompt = rng.integers(0, 64, (5,)).astype(np.int32)
+            try:
+                inter_reqs.append(engine.submit(
+                    prompt, max_new_tokens=8, deadline_s=60.0,
+                    priority="interactive"))
+            except Overloaded:
+                inter_shed += 1
+        drain_inline(engine, inter_reqs)
+        refs = {r.id: reference_tokens(bundle, r.prompt.tolist(), 8)
+                for r in inter_reqs}
+        exact, prefix, corrupt = check_outputs(bundle, inter_reqs, refs)
+        displaced = sum(1 for r in batch_reqs
+                        if r.status == "cancelled"
+                        and "displaced" in r.detail)
+        return {
+            "interactive_ok": sum(1 for r in inter_reqs
+                                  if r.status == "ok"),
+            "interactive_shed": inter_shed,
+            "interactive_deadline_miss": sum(
+                1 for r in inter_reqs
+                if r.finished_at is not None
+                and r.finished_at > r.deadline),
+            "batch_shed": batch_shed + displaced,
+            "batch_displaced": displaced,
+            "corrupt": corrupt,
+        }
+
+    return run_scenario(scenario, run)
+
+
+def scenario_eviction_under_lease(bundle):
+    """A one-row prefix pool, a resident donor row, and a resumed
+    request holding its lease: a third request's insert must be REFUSED
+    room (never evict under lease), and the leasing request still
+    completes byte-exact — reuse is an optimization, eviction is not
+    allowed to corrupt an in-flight splice."""
+    from mmlspark_tpu.resilience.chaos import Scenario, run_scenario
+
+    scenario = Scenario(
+        "eviction_under_lease",
+        expect={"all_ok": 3, "reuse_exact": True, "min_hits": 1,
+                "min_evictions_refused": 1, "evictions": 0,
+                "corrupt": 0})
+
+    def run():
+        engine = make_engine(bundle, prefill_chunk=16, prefix_cache=True,
+                             prefix_max_rows=1)
+        engine.warmup()
+        rng = np.random.default_rng(7)
+        # donor: its first 16-token chunk becomes the pool's only row
+        donor = (rng.integers(1, 64, (20,))).astype(np.int32)
+        a = engine.submit(donor, max_new_tokens=8, deadline_s=60.0)
+        drain_inline(engine, [a])
+        # C (fresh prefix, wants to insert) and B (shares the donor's
+        # first chunk -> resume splice holds the lease) are in flight
+        # together: C's insert finds the pool full and the only row
+        # leased, so making room is refused until B's splice lands
+        other = (rng.integers(1, 64, (20,))).astype(np.int32)
+        shared = np.concatenate(
+            [donor[:16], rng.integers(1, 64, (24,)).astype(np.int32)])
+        c = engine.submit(other, max_new_tokens=8, deadline_s=60.0)
+        b = engine.submit(shared, max_new_tokens=8, deadline_s=60.0)
+        drain_inline(engine, [b, c])
+        reqs = [a, b, c]
+        refs = {r.id: reference_tokens(bundle, r.prompt.tolist(), 8)
+                for r in reqs}
+        exact, prefix, corrupt = check_outputs(bundle, reqs, refs)
+        stats = engine.prefix_stats() or {}
+        return {
+            "all_ok": sum(1 for r in reqs if r.status == "ok"),
+            "reuse_exact": bool(b.status == "ok"
+                                and b.tokens == refs[b.id]),
+            "hits": stats.get("hits", 0),
+            "evictions_refused": stats.get("evictions_refused", 0),
+            "evictions": stats.get("evictions", 0),
+            "leaked_leases": stats.get("leased_rows", 0),
+            "corrupt": corrupt,
+        }
+
+    return run_scenario(scenario, run)
+
+
+def check_priority_timeline(summary: dict) -> dict:
+    """The weighted-shedding half of the strict-priority contract, read
+    off the run_summary serve timeline: shed events hit the batch lane
+    (share cap + displacement), and no interactive completion anywhere
+    in the run missed its deadline while that was happening."""
+    serve = summary.get("serve", [])
+    batch_sheds = [e for e in serve if e.get("event") == "shed"
+                   and e.get("priority") == "batch"]
+    displaced = [e for e in serve if e.get("event") == "shed"
+                 and e.get("reason") == "displaced"]
+    inter_misses = [e for e in serve if e.get("event") == "finish"
+                    and e.get("priority") == "interactive"
+                    and e.get("deadline_miss")]
+    checks = {
+        "batch_sheds_present": len(batch_sheds) >= 3,
+        "displacement_present": len(displaced) >= 1,
+        "zero_interactive_deadline_misses": len(inter_misses) == 0,
+    }
+    return {"name": "strict_priority_timeline",
+            "passed": all(checks.values()),
+            "checks": {k: {"want": True, "got": v, "ok": bool(v)}
+                       for k, v in checks.items()},
+            "observed": {"batch_sheds": len(batch_sheds),
+                         "displaced": len(displaced),
+                         "interactive_misses": len(inter_misses)}}
+
+
+def check_prefix_timeline(summary: dict) -> dict:
+    """The lease half of the eviction drill, read off the run_summary
+    prefix timeline: the resume hit and the refused eviction both
+    surfaced as telemetry events (hit/insert/evict_refused), so the
+    pool's behavior is observable after the fact, not just in-process."""
+    prefix = summary.get("prefix", [])
+    events = [e.get("event") for e in prefix]
+    checks = {
+        "hit_present": "hit" in events,
+        "insert_present": "insert" in events,
+        "evict_refused_present": "evict_refused" in events,
+    }
+    return {"name": "prefix_timeline",
+            "passed": all(checks.values()),
+            "checks": {k: {"want": True, "got": v, "ok": bool(v)}
+                       for k, v in checks.items()},
+            "observed": {"events": events[:40]}}
+
+
 def check_chunked_timeline(summary: dict) -> dict:
     """The cadence half of the chunked-prefill contract, read off the
     run_summary.json serve timeline: the long prompt's prefill appears
@@ -399,8 +591,17 @@ def check_chunked_timeline(summary: dict) -> dict:
     the prefill — and the cohort's `join` lands only after the last
     chunk."""
     serve = summary.get("serve", [])
-    chunk_idx = [i for i, e in enumerate(serve)
-                 if e.get("event") == "prefill_chunk"]
+    # scope to the long prompt's 48 bucket and to the FIRST chunk run:
+    # the later prefix drills emit their own prefill_chunk (and resume)
+    # ticks, which start at index >= 1 and are not this contract
+    chunk_idx = []
+    for i, e in enumerate(serve):
+        if e.get("event") == "prefill_chunk" and e.get("bucket") == 48:
+            if chunk_idx and e.get("index") == 0:
+                break               # a later scenario's first chunk
+            chunk_idx.append(i)
+            if e.get("index") == e.get("chunks", 0) - 1:
+                break               # the run completed
     indices = [serve[i].get("index") for i in chunk_idx]
     segs_between = [
         i for i, e in enumerate(serve)
@@ -460,12 +661,16 @@ def main() -> int:
             for scenario_fn in (scenario_burst, scenario_hung_client,
                                 scenario_poison,
                                 scenario_midflight_sigterm,
-                                scenario_chunked_prefill):
+                                scenario_chunked_prefill,
+                                scenario_strict_priority,
+                                scenario_eviction_under_lease):
                 reports.append(scenario_fn(bundle))
             summary = rt.summary()
         final = rt.finish() or summary
         reports.append(check_timeline(final))
         reports.append(check_chunked_timeline(final))
+        reports.append(check_priority_timeline(final))
+        reports.append(check_prefix_timeline(final))
 
     passed = all(r["passed"] for r in reports)
     if args.json:

@@ -196,7 +196,12 @@ def test_bench_serve_contract_fields():
     * fleet: a 2-replica router with one replica chaos-degraded keeps
       most of the single-healthy-replica goodput because health-aware
       routing shifts load onto the healthy replica (share pinned), and
-      every fleet response stays byte-exact."""
+      every fleet response stays byte-exact;
+    * prefix reuse: the zipf shared-prefix workload through the SAME
+      engine config with and without the radix prefix pool must at
+      least double goodput (prefill compute dominates that arm by
+      construction, so the win is arithmetic saved, not scheduler
+      luck) at byte-identical greedy outputs."""
     import bench
     result = bench.bench_serve(smoke=True)
     assert {"metric", "value", "unit", "vs_baseline",
@@ -211,7 +216,12 @@ def test_bench_serve_contract_fields():
             "single_goodput_tokens_per_sec",
             "fleet_vs_single_goodput_ratio",
             "fleet_routed_share_healthy",
-            "fleet_greedy_match"} <= set(result)
+            "fleet_greedy_match",
+            "prefix_goodput_tokens_per_sec",
+            "noprefix_goodput_tokens_per_sec",
+            "prefix_vs_noreuse_goodput_ratio",
+            "prefix_hit_rate", "prefix_suffix_prefill_fraction",
+            "prefix_greedy_match"} <= set(result)
     assert result["metric"] == "serve_continuous_goodput_tokens_per_sec"
     assert result["value"] > 0
     # the continuous-batching goodput pin (the ISSUE's acceptance gate)
@@ -234,6 +244,14 @@ def test_bench_serve_contract_fields():
     assert result["fleet_routed_share_healthy"] >= 0.55, result
     assert result["fleet_vs_single_goodput_ratio"] >= 0.6, result
     assert result["fleet_greedy_match"] is True
+    # prefix reuse: the ISSUE-17 acceptance gate — >= 2x goodput on the
+    # zipf shared-prefix workload (measured ~4-7x on CPU: a hit skips
+    # all but one prefill chunk) at byte-identical greedy outputs, with
+    # the hit rate and the remaining suffix-prefill fraction reported
+    assert result["prefix_vs_noreuse_goodput_ratio"] >= 2.0, result
+    assert result["prefix_greedy_match"] is True
+    assert result["prefix_hit_rate"] > 0.5, result
+    assert 0.0 < result["prefix_suffix_prefill_fraction"] < 0.5, result
 
 
 def test_bench_lm_train_contract_fields():

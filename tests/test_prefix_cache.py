@@ -1,0 +1,346 @@
+"""Cross-request radix prefix KV cache tests (serve/prefix_cache.py):
+chunk-granular hashing, longest-prefix match, lease pinning, LRU order,
+int8 payloads, affinity-key stability — plus the priority-lane admission
+policy and the engine-level byte-exactness contract (reuse is an
+optimization: greedy outputs with and without the pool must be
+byte-identical).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.bundle import ModelBundle
+from mmlspark_tpu.models.definitions import build_model
+from mmlspark_tpu.resilience.clock import VirtualClock
+from mmlspark_tpu.serve import (AdmissionController, Overloaded,
+                                PrefixCache, Request, ServeConfig,
+                                ServingEngine, StepTimeEstimator)
+
+CHUNK = 4
+
+
+def fake_row(n_slots, seed=0, dtype=np.float32):
+    """A model-dtype cache row stand-in: payloads are opaque to the
+    pool, so plain numpy arrays with slot axis 1 exercise it fully."""
+    rng = np.random.default_rng(seed)
+    return [tuple(rng.standard_normal((1, n_slots, 2, 3)).astype(dtype)
+                  for _ in range(2))
+            for _ in range(2)]
+
+
+def fake_int8_row(n_slots, seed=0):
+    """An int8-layout row: 4-tuple (kq, k_scale, vq, v_scale) per layer
+    with (B, W, H) scale arrays — the quantized resident-KV layout."""
+    rng = np.random.default_rng(seed)
+    layers = []
+    for _ in range(2):
+        kq = rng.integers(-127, 128, (1, n_slots, 2, 3)).astype(np.int8)
+        ks = rng.standard_normal((1, n_slots, 2)).astype(np.float32)
+        vq = rng.integers(-127, 128, (1, n_slots, 2, 3)).astype(np.int8)
+        vs = rng.standard_normal((1, n_slots, 2)).astype(np.float32)
+        layers.append((kq, ks, vq, vs))
+    return layers
+
+
+def toks(*vals):
+    return np.asarray(vals, np.int32)
+
+
+# ---------------------------------------------------------------------------
+# the pool itself (no engine, fake rows)
+# ---------------------------------------------------------------------------
+
+def test_miss_then_hit_and_longest_prefix_match():
+    pc = PrefixCache(CHUNK, max_rows=8)
+    prompt = np.arange(12, dtype=np.int32)
+    assert pc.acquire(prompt) is None          # empty pool: miss
+    pc.insert(prompt, 8, fake_row(8))
+    hit = pc.acquire(prompt)
+    assert hit is not None and hit.n_tokens == 8
+    assert len(hit.rows) == 2                  # one payload per chunk
+    pc.release(hit)
+    # a prompt sharing only the first chunk matches at depth 1
+    other = np.concatenate([prompt[:4], toks(50, 51, 52, 53, 54)])
+    hit = pc.acquire(other)
+    assert hit.n_tokens == 4
+    pc.release(hit)
+
+
+def test_chunk_granular_hashing():
+    """Changing ONE token inside chunk i kills the match from chunk i on
+    but keeps every chunk before it — the radix property."""
+    pc = PrefixCache(CHUNK, max_rows=8)
+    prompt = np.arange(12, dtype=np.int32)
+    pc.insert(prompt, 12, fake_row(12))
+    for flip, want in ((1, 0), (5, 4), (9, 8)):
+        mutated = prompt.copy()
+        mutated[flip] = 63
+        hit = pc.acquire(mutated)
+        got = 0 if hit is None else hit.n_tokens
+        assert got == want, (flip, got, want)
+        if hit is not None:
+            pc.release(hit)
+
+
+def test_acquire_limit_caps_match_depth():
+    """The engine passes the largest chunk multiple strictly inside the
+    prompt as `limit`, so the resumed prefill always recomputes the last
+    prompt position — the pool must honor it."""
+    pc = PrefixCache(CHUNK, max_rows=8)
+    prompt = np.arange(12, dtype=np.int32)
+    pc.insert(prompt, 8, fake_row(8))
+    hit = pc.acquire(prompt, limit=4)
+    assert hit.n_tokens == 4
+    pc.release(hit)
+
+
+def test_lease_blocks_eviction_until_release():
+    pc = PrefixCache(CHUNK, max_rows=1)
+    a = np.arange(4, dtype=np.int32)
+    b = np.arange(10, 14, dtype=np.int32)
+    pc.insert(a, 4, fake_row(4, seed=1))
+    hit = pc.acquire(a)
+    # pool full, only row leased: the insert is REFUSED, never forced
+    res = pc.insert(b, 4, fake_row(4, seed=2))
+    assert res == {"inserted": 0, "evicted": 0, "refused": True}
+    assert pc.stats()["evictions_refused"] == 1
+    hit2 = pc.acquire(a, limit=4)                  # donor row intact
+    assert hit2.n_tokens == 4
+    pc.release(hit)
+    pc.release(hit2)
+    # lease gone: the same insert now evicts the stale row
+    res = pc.insert(b, 4, fake_row(4, seed=2))
+    assert res["inserted"] == 1 and res["evicted"] == 1
+    assert pc.acquire(a) is None
+
+
+def test_lru_order_under_interleaved_hits():
+    """A hit bumps its row's recency, so the OTHER resident is the
+    eviction victim when room is needed."""
+    pc = PrefixCache(CHUNK, max_rows=2)
+    a = toks(1, 2, 3, 4)
+    b = toks(5, 6, 7, 8)
+    c = toks(9, 10, 11, 12)
+    pc.insert(a, 4, fake_row(4, seed=1))
+    pc.insert(b, 4, fake_row(4, seed=2))           # b now most recent
+    pc.release(pc.acquire(a))                      # a bumped past b
+    res = pc.insert(c, 4, fake_row(4, seed=3))
+    assert res["evicted"] == 1
+    assert pc.acquire(b) is None                   # b was the stalest
+    pc.release(pc.acquire(a))
+    pc.release(pc.acquire(c))
+
+
+def test_interior_nodes_pinned_by_descendants():
+    """Eviction only takes leaves: an ancestor chunk with a resident
+    child is never a victim (evicting it would orphan the child's
+    resume path)."""
+    pc = PrefixCache(CHUNK, max_rows=2)
+    long_prompt = np.arange(8, dtype=np.int32)
+    pc.insert(long_prompt, 8, fake_row(8))         # chunk0 <- chunk1
+    other = toks(20, 21, 22, 23)
+    res = pc.insert(other, 4, fake_row(4, seed=4))
+    assert res["inserted"] == 1 and res["evicted"] == 1
+    # the LEAF (chunk 1) went; the interior chunk 0 must survive
+    hit = pc.acquire(long_prompt)
+    assert hit.n_tokens == 4
+    pc.release(hit)
+
+
+def test_first_writer_wins_and_byte_budget():
+    pc = PrefixCache(CHUNK, max_rows=8)
+    prompt = np.arange(4, dtype=np.int32)
+    first = fake_row(4, seed=1)
+    second = fake_row(4, seed=2)
+    pc.insert(prompt, 4, first)
+    pc.insert(prompt, 4, second)                   # resident: no-op
+    hit = pc.acquire(prompt)
+    assert np.array_equal(hit.rows[0][0][0], first[0][0][:, :4])
+    assert not np.array_equal(hit.rows[0][0][0], second[0][0][:, :4])
+    pc.release(hit)
+    assert pc.stats()["inserts"] == 1
+    assert pc.stats()["resident_bytes"] == sum(
+        t.nbytes for layer in first for t in layer)
+
+
+def test_max_bytes_budget_evicts():
+    row = fake_row(4, seed=1)
+    row_bytes = sum(t.nbytes for layer in row for t in layer)
+    pc = PrefixCache(CHUNK, max_rows=64, max_bytes=row_bytes + 1)
+    pc.insert(toks(1, 2, 3, 4), 4, row)
+    res = pc.insert(toks(5, 6, 7, 8), 4, fake_row(4, seed=2))
+    assert res["evicted"] == 1                     # byte cap, not rows
+    assert pc.stats()["resident_rows"] == 1
+
+
+def test_int8_rows_ride_through_and_are_smaller():
+    pc8 = PrefixCache(CHUNK, max_rows=8)
+    pcf = PrefixCache(CHUNK, max_rows=8)
+    prompt = np.arange(8, dtype=np.int32)
+    pc8.insert(prompt, 8, fake_int8_row(8))
+    pcf.insert(prompt, 8, fake_row(8))
+    hit = pc8.acquire(prompt)
+    assert hit.n_tokens == 8
+    assert len(hit.rows[0][0]) == 4                # 4-tuple int8 layout
+    for payload in hit.rows:
+        for layer in payload:
+            assert layer[0].dtype == np.int8
+            assert layer[0].shape[1] == CHUNK      # slot axis sliced
+    pc8.release(hit)
+    assert (pc8.stats()["resident_bytes"]
+            < pcf.stats()["resident_bytes"])       # the ~4x composition
+
+
+def test_affinity_key_stable_across_instances_and_restarts():
+    prompt = np.arange(64, dtype=np.int32)
+    k1 = PrefixCache.affinity_key(prompt, 16)
+    k2 = PrefixCache.affinity_key(prompt.copy(), 16)
+    assert k1 == k2
+    # only the FIRST chunk participates: suffix changes don't move it
+    mutated = prompt.copy()
+    mutated[40] = 0
+    assert PrefixCache.affinity_key(mutated, 16) == k1
+    mutated = prompt.copy()
+    mutated[3] = 0
+    assert PrefixCache.affinity_key(mutated, 16) != k1
+    # pinned literal: blake2b over raw int32 bytes, never Python
+    # hash() — a changed value here means every fleet's placement moved
+    assert PrefixCache.affinity_key(np.arange(16, dtype=np.int32),
+                                    16) == "26ec4e1c03e59b30"
+
+
+# ---------------------------------------------------------------------------
+# priority lanes (pure admission policy, virtual clock)
+# ---------------------------------------------------------------------------
+
+def _req(clock, rid, priority, plen=5):
+    now = clock.monotonic()
+    return Request(rid, np.ones(plen, np.int32), 8, 8, now, now + 60.0,
+                   priority=priority)
+
+
+def test_interactive_served_before_batch():
+    clock = VirtualClock()
+    adm = AdmissionController(8, StepTimeEstimator(), clock=clock)
+    adm.try_admit(_req(clock, 1, "batch"))
+    adm.try_admit(_req(clock, 2, "interactive"))
+    adm.try_admit(_req(clock, 3, "batch"))
+    adm.try_admit(_req(clock, 4, "interactive"))
+    got = [r.id for r in adm.take(8, 4, "primary")]
+    assert got == [2, 4, 1, 3]                     # lane first, FIFO within
+
+
+def test_batch_share_cap_sheds_batch_only():
+    clock = VirtualClock()
+    adm = AdmissionController(4, StepTimeEstimator(), clock=clock,
+                              batch_share=0.5)
+    adm.try_admit(_req(clock, 1, "batch"))
+    adm.try_admit(_req(clock, 2, "batch"))
+    with pytest.raises(Overloaded) as e:
+        adm.try_admit(_req(clock, 3, "batch"))     # share cap: 4*0.5 = 2
+    assert e.value.reason == "queue_full"
+    adm.try_admit(_req(clock, 4, "interactive"))   # interactive still fits
+    assert adm.pending() == 3
+
+
+def test_interactive_displaces_newest_batch_at_capacity():
+    clock = VirtualClock()
+    adm = AdmissionController(2, StepTimeEstimator(), clock=clock)
+    adm.try_admit(_req(clock, 1, "batch"))
+    adm.try_admit(_req(clock, 2, "batch"))
+    adm.try_admit(_req(clock, 3, "interactive"))   # displaces newest batch
+    displaced = adm.drain_displaced()
+    assert [r.id for r in displaced] == [2]
+    assert [r.id for r in adm.take(8, 2, "primary")] == [3, 1]
+    # a batch arrival at capacity never displaces anyone
+    adm.try_admit(_req(clock, 4, "interactive"))
+    adm.try_admit(_req(clock, 5, "interactive"))
+    with pytest.raises(Overloaded):
+        adm.try_admit(_req(clock, 6, "batch"))
+    assert adm.drain_displaced() == []
+
+
+# ---------------------------------------------------------------------------
+# engine-level byte-exactness (the correctness contract)
+# ---------------------------------------------------------------------------
+
+CFG = {"vocab_size": 64, "d_model": 32, "n_heads": 4, "n_layers": 2,
+       "max_len": 64}
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    model = build_model("TransformerLM", CFG)
+    variables = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    return ModelBundle.from_module(model, variables)
+
+
+def make_engine(bundle, clock, **overrides):
+    kw = dict(max_new_tokens=8, max_batch=4, queue_capacity=8,
+              segment_steps=4, default_deadline_s=100.0,
+              cache_chunk=16, prefix_cache=True, prefix_max_rows=16)
+    kw.update(overrides)
+    return ServingEngine(bundle, ServeConfig(**kw), clock=clock)
+
+
+def drain(engine, requests, max_ticks=300):
+    for _ in range(max_ticks):
+        if all(r.finished for r in requests):
+            return
+        engine._tick()
+    raise AssertionError([r.status for r in requests])
+
+
+def test_prefill_tier_rejects_prefix_cache():
+    """Satellite 6: a prefill-tier replica ships its rows over the
+    handoff bus — a resident pool there would double-cache every
+    prefix.  The config must refuse the combination outright."""
+    with pytest.raises(ValueError, match="decode"):
+        ServeConfig(role="prefill", prefix_cache=True)
+    # decode + colocated both allow it
+    assert ServeConfig(role="decode", prefix_cache=True).prefix_cache
+    assert ServeConfig(prefix_cache=True).prefix_cache
+
+
+def test_engine_reuse_byte_exact_whole_join(bundle):
+    clock = VirtualClock()
+    eng = make_engine(bundle, clock)
+    eng.warmup()
+    prompt = (np.arange(1, 21, dtype=np.int32) % 63) + 1
+    first = eng.submit(prompt)
+    drain(eng, [first])
+    second = eng.submit(prompt)
+    drain(eng, [second])
+    assert first.status == second.status == "ok"
+    assert first.tokens == second.tokens           # byte-identical
+    stats = eng.prefix_stats()
+    assert stats["hits"] >= 1 and stats["misses"] >= 1
+    assert stats["leased_rows"] == 0               # no leaked leases
+    assert eng.stats()["prefix"]["hits"] == stats["hits"]
+
+
+def test_engine_reuse_byte_exact_chunked_resume(bundle):
+    """A 40-token prompt sharing two 16-token chunks with a resident
+    donor resumes CHUNKED prefill at offset 32 — and must match a
+    fresh engine's output byte-for-byte."""
+    donor = (np.arange(1, 41, dtype=np.int32) % 63) + 1
+    shared = donor.copy()
+    shared[36:] = 7                                # diverge in the tail
+
+    fresh_eng = make_engine(bundle, VirtualClock(), prefix_cache=False,
+                            prefill_chunk=16)
+    fresh_eng.warmup()
+    fresh = fresh_eng.submit(shared)
+    drain(fresh_eng, [fresh])
+
+    eng = make_engine(bundle, VirtualClock(), prefill_chunk=16)
+    eng.warmup()
+    a = eng.submit(donor)
+    drain(eng, [a])
+    b = eng.submit(shared)
+    drain(eng, [b])
+    assert fresh.status == b.status == "ok"
+    assert b.tokens == fresh.tokens
+    stats = eng.prefix_stats()
+    assert stats["hits"] >= 1 and stats["leased_rows"] == 0

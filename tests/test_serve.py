@@ -566,7 +566,8 @@ def test_retry_after_headers_on_429_and_503():
         def stats(self):
             return {"state": self.state}
 
-        def submit(self, prompt, max_new_tokens=None, deadline_s=None):
+        def submit(self, prompt, max_new_tokens=None, deadline_s=None,
+                   priority=None):
             now = self.now()
             if self.mode == "front_door_shed":
                 raise Overloaded("queue_full", 3.25, "queue at capacity")

@@ -16,10 +16,13 @@ import numpy as np
 
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.pipeline import Estimator, Transformer, load_stage
+from mmlspark_tpu.core.schema import SchemaConstants
 from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.ml.statistics import (ACCURACY, AUC, MAE, METRIC_TO_COLUMN,
                                         MSE, PRECISION, R2, RECALL, RMSE,
-                                        ComputeModelStatistics)
+                                        ComputeModelStatistics,
+                                        _label_indices, _metrics_from_confusion,
+                                        _schema_info, confusion_matrix_batch)
 
 _LOWER_IS_BETTER = {MSE, RMSE, MAE}
 
@@ -46,22 +49,26 @@ class FindBestModel(Estimator):
         col_name = METRIC_TO_COLUMN[metric]
         lower = metric in _LOWER_IS_BETTER
 
-        rows = []
+        scored_tables = [model.transform(table) for model in self._models]
+        rows = self._batched_rows(scored_tables)
+        if rows is None:
+            rows = [self._serial_row(model, scored)
+                    for model, scored in zip(self._models, scored_tables)]
         best = None
-        for model in self._models:
-            scored = model.transform(table)
-            result = ComputeModelStatistics().evaluate(scored)
-            metrics = result.metrics
-            if col_name not in metrics:
+        for i, (model, row) in enumerate(zip(self._models, rows)):
+            if col_name not in row:
                 raise ValueError(
                     f"metric '{metric}' not produced for model "
                     f"{type(model).__name__} (wrong model kind?)")
-            value = float(metrics[col_name][0])
-            rows.append({"model_name": model.uid,
-                         **{c: float(metrics[c][0]) for c in metrics.columns}})
+            value = float(row[col_name])
             if best is None or (value < best[1] if lower else value > best[1]):
-                best = (model, value, result)
-        best_model, best_value, best_result = best
+                best = (model, value, i)
+        best_model, best_value, best_i = best
+        # the winner alone takes the full evaluator pass (its metrics
+        # table, confusion matrix, and ROC back the BestModel surface);
+        # the non-winners were ranked from the batched confusion matrices
+        best_result = ComputeModelStatistics().evaluate(
+            scored_tables[best_i])
         # models of different arities emit different metric columns (binary
         # AUC vs multiclass macro_*): take the union, NaN where absent
         all_cols: list[str] = []
@@ -74,6 +81,56 @@ class FindBestModel(Estimator):
                          DataTable(table_cols),
                          roc=best_result.roc,
                          evaluationMetric=metric)
+
+    def _serial_row(self, model: Transformer, scored: DataTable) -> dict:
+        """One full evaluator pass (the pre-batched path, kept for
+        regression models and mixed-arity candidate sets)."""
+        metrics = ComputeModelStatistics().evaluate(scored).metrics
+        return {"model_name": model.uid,
+                **{c: float(metrics[c][0]) for c in metrics.columns}}
+
+    def _batched_rows(self, scored_tables: list) -> Optional[list]:
+        """Rank every classification candidate from ONE vectorized
+        confusion-matrix pass (statistics.confusion_matrix_batch) instead
+        of a per-model evaluator round trip — the redundant host work the
+        serial loop paid between fits.  Returns None (caller falls back
+        to the serial path) when the candidates are not uniformly
+        same-arity classifiers: regression metrics and mixed
+        binary/multiclass sets keep the per-model evaluator."""
+        ys, yps, probs_list, n_cls = [], [], [], set()
+        for scored in scored_tables:
+            try:
+                kind, label, scores, scored_labels, probs = _schema_info(
+                    scored, None)
+            except ValueError:
+                return None
+            if kind != SchemaConstants.CLASSIFICATION_KIND:
+                return None
+            pred_col = scored_labels or scores
+            try:
+                y = _label_indices(scored, label, pred_col)
+            except ValueError:
+                return None
+            yp = np.asarray(scored[pred_col], np.float64).astype(np.int64)
+            levels = scored.meta(pred_col).categorical
+            n_cls.add(max(levels.num_levels if levels is not None else 0,
+                          int(max(y.max(initial=0), yp.max(initial=0))) + 1,
+                          2))
+            ys.append(y)
+            yps.append(yp)
+            probs_list.append(
+                np.asarray(scored[probs], np.float64)
+                if probs is not None and probs in scored else None)
+        if len(n_cls) != 1 or len({len(y) for y in ys}) != 1:
+            return None  # mixed arities / row counts: evaluate per model
+        k = n_cls.pop()
+        cms = confusion_matrix_batch(np.stack(ys), np.stack(yps),
+                                     n_classes=k)
+        rows = []
+        for model, cm, y, p in zip(self._models, cms, ys, probs_list):
+            out, _ = _metrics_from_confusion(cm, y, p)
+            rows.append({"model_name": model.uid, **out})
+        return rows
 
 
 class BestModel(Transformer):

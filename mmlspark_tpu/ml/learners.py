@@ -422,8 +422,10 @@ class MultilayerPerceptronClassifier(Estimator):
     stepSize = Param(0.005, "learning rate", ptype=float)
     seed = Param(0, "init/shuffle seed", ptype=int)
 
-    def fit(self, table: DataTable) -> MultilayerPerceptronClassifierModel:
-        from mmlspark_tpu.train import Trainer, TrainerConfig
+    def _fit_inputs(self, table: DataTable):
+        """(X, y, trainer config) shared by the single fit and the
+        population sweep — both train the IDENTICAL program per member."""
+        from mmlspark_tpu.train import TrainerConfig
         self._check_required()
         layers = list(self.layers)
         if len(layers) < 2:
@@ -442,7 +444,50 @@ class MultilayerPerceptronClassifier(Estimator):
             epochs=int(self.maxIter),
             batch_size=int(min(max(len(X), 1), 4096)),
             loss="softmax_xent", seed=int(self.seed))
+        return X, y, cfg
+
+    def fit(self, table: DataTable) -> MultilayerPerceptronClassifierModel:
+        from mmlspark_tpu.train import Trainer
+        X, y, cfg = self._fit_inputs(table)
         trainer = Trainer(cfg)
         bundle = trainer.fit_arrays(X, y.astype(np.int32))
         return MultilayerPerceptronClassifierModel(
             bundle, featuresCol=self.featuresCol)
+
+    def fit_population(self, table: DataTable, learning_rates,
+                       halving_rungs: int = 0):
+        """Train one MLP candidate per learning rate as a vmapped
+        population (train/sweep.py) — N models in ONE compiled program —
+        then pick the winner by a single batched evaluation: one vmapped
+        forward scores every member, one `classification_report_batch`
+        ranks them (no per-candidate transform/evaluate round trips).
+
+        Returns (winner model, per-member metrics DataTable ordered like
+        `learning_rates`, with `learning_rate`/`final_loss`/`active`
+        columns joined on)."""
+        from mmlspark_tpu.ml.statistics import classification_report_batch
+        from mmlspark_tpu.train import PopulationTrainer
+        X, y, cfg = self._fit_inputs(table)
+        rates = [float(r) for r in learning_rates]
+        if not rates:
+            raise ParamError("fit_population needs at least one rate")
+        pt = PopulationTrainer(cfg, [{"learning_rate": r} for r in rates],
+                               halving_rungs=int(halving_rungs))
+        result = pt.fit_arrays(X, y.astype(np.int32))
+        logits = pt.score_population(result.state, X)   # (N, rows, classes)
+        preds = np.argmax(logits, axis=-1)
+        report = classification_report_batch(
+            y, preds, model_uids=[f"member_{k}_lr={r:g}"
+                                  for k, r in enumerate(rates)])
+        acc = np.asarray(report["accuracy"], np.float64)
+        ranked = np.where(result.active > 0, acc, -np.inf)
+        best = int(np.argmax(ranked))
+        report = report.with_column("learning_rate",
+                                    np.asarray(rates, np.float64))
+        report = report.with_column("final_loss",
+                                    result.final_losses().astype(np.float64))
+        report = report.with_column("active",
+                                    result.active.astype(np.float64))
+        model = MultilayerPerceptronClassifierModel(
+            result.member_bundle(best), featuresCol=self.featuresCol)
+        return model, report

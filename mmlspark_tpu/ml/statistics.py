@@ -251,42 +251,115 @@ class ComputeModelStatistics(Evaluator):
             levels.num_levels if levels is not None else 0,
             int(max(y.max(initial=0), yp.max(initial=0))) + 1, 2)
         cm = confusion_matrix(y, yp, n_classes)
-        roc = None
-
-        out: dict[str, float] = {}
-        if n_classes == 2:
-            tn, fp, fn, tp = cm[0, 0], cm[0, 1], cm[1, 0], cm[1, 1]
-            total = cm.sum()
-            out[ACCURACY] = float((tp + tn) / max(total, 1))
-            out[PRECISION] = float(tp / max(tp + fp, 1))
-            out[RECALL] = float(tp / max(tp + fn, 1))
-            if probs is not None:
-                p = np.asarray(table[probs], np.float64)
-                pos = p[:, 1] if p.ndim == 2 else p
-                roc = roc_curve(y, pos)
-                fpr, tpr, _ = roc
-                out[AUC] = float(np.trapezoid(tpr, fpr))
-        else:
-            # micro-averaged accuracy == overall accuracy; macro averages
-            # per-class (scala:375-429)
-            diag = np.diag(cm).astype(np.float64)
-            row = cm.sum(axis=1).astype(np.float64)  # per true class
-            col = cm.sum(axis=0).astype(np.float64)  # per predicted class
-            micro = float(diag.sum() / max(cm.sum(), 1))
-            out[ACCURACY] = micro
-            out[PRECISION] = micro   # micro precision == micro recall == acc
-            out[RECALL] = micro
-            out[AVG_ACCURACY] = float(np.mean(
-                (cm.sum() - row - col + 2 * diag) / max(cm.sum(), 1)))
-            out[MACRO_PRECISION] = float(np.mean(diag / np.maximum(col, 1)))
-            out[MACRO_RECALL] = float(np.mean(diag / np.maximum(row, 1)))
-            if metric == AUC:
-                raise ValueError("AUC is not available for multiclass "
-                                 "(scala:173)")
+        p = np.asarray(table[probs], np.float64) if probs is not None \
+            else None
+        out, roc = _metrics_from_confusion(cm, y, p)
+        if n_classes != 2 and metric == AUC:
+            raise ValueError("AUC is not available for multiclass "
+                             "(scala:173)")
         if metric in CLASSIFICATION_METRICS and metric in out:
             out = {metric: out[metric]}
         return EvalResult(DataTable({k: [v] for k, v in out.items()}),
                           confusion_matrix=cm, roc=roc)
+
+
+def _metrics_from_confusion(cm: np.ndarray, y: Optional[np.ndarray] = None,
+                            probs: Optional[np.ndarray] = None
+                            ) -> tuple[dict, Optional[tuple]]:
+    """The classification metric arithmetic on ONE confusion matrix
+    (binary: accuracy/precision/recall + AUC when probabilities are
+    given; multiclass: micro + the macro family, scala:375-429).  Shared
+    by the serial evaluator and `classification_report_batch`, so the
+    batched sweep path agrees with per-model evaluation by construction."""
+    out: dict[str, float] = {}
+    roc = None
+    if cm.shape[0] == 2:
+        tn, fp, fn, tp = cm[0, 0], cm[0, 1], cm[1, 0], cm[1, 1]
+        total = cm.sum()
+        out[ACCURACY] = float((tp + tn) / max(total, 1))
+        out[PRECISION] = float(tp / max(tp + fp, 1))
+        out[RECALL] = float(tp / max(tp + fn, 1))
+        if probs is not None and y is not None:
+            pos = probs[:, 1] if probs.ndim == 2 else probs
+            roc = roc_curve(y, pos)
+            fpr, tpr, _ = roc
+            out[AUC] = float(np.trapezoid(tpr, fpr))
+    else:
+        # micro-averaged accuracy == overall accuracy; macro averages
+        # per-class (scala:375-429)
+        diag = np.diag(cm).astype(np.float64)
+        row = cm.sum(axis=1).astype(np.float64)  # per true class
+        col = cm.sum(axis=0).astype(np.float64)  # per predicted class
+        micro = float(diag.sum() / max(cm.sum(), 1))
+        out[ACCURACY] = micro
+        out[PRECISION] = micro   # micro precision == micro recall == acc
+        out[RECALL] = micro
+        out[AVG_ACCURACY] = float(np.mean(
+            (cm.sum() - row - col + 2 * diag) / max(cm.sum(), 1)))
+        out[MACRO_PRECISION] = float(np.mean(diag / np.maximum(col, 1)))
+        out[MACRO_RECALL] = float(np.mean(diag / np.maximum(row, 1)))
+    return out, roc
+
+
+def confusion_matrix_batch(y_true_stack: np.ndarray,
+                           y_pred_stack: np.ndarray,
+                           n_classes: Optional[int] = None) -> np.ndarray:
+    """(M, k, k) confusion matrices for M models in ONE scatter-add pass
+    — the host-side cost of evaluating a whole sweep population is one
+    vectorized histogram instead of M table round trips."""
+    yt = np.asarray(y_true_stack, np.int64)
+    yp = np.asarray(y_pred_stack, np.int64)
+    if yp.ndim != 2:
+        raise ValueError(f"predictions must be stacked (M, rows); got "
+                         f"shape {yp.shape}")
+    m, n = yp.shape
+    if yt.ndim == 1:
+        yt = np.broadcast_to(yt, (m, n))
+    k = n_classes or int(max(yt.max(initial=0), yp.max(initial=0))) + 1
+    k = max(k, 2)
+    cms = np.zeros((m, k, k), np.int64)
+    mi = np.broadcast_to(np.arange(m)[:, None], (m, n))
+    np.add.at(cms, (mi, yt, yp), 1)
+    return cms
+
+
+def classification_report_batch(y_true, y_pred_stack,
+                                model_uids: Optional[list] = None,
+                                probs_stack: Optional[np.ndarray] = None,
+                                n_classes: Optional[int] = None) -> DataTable:
+    """Evaluate M models' stacked predictions in one batched pass.
+
+    `y_pred_stack` is (M, rows) predicted class indices — e.g. a
+    population sweep's `score_population` argmax — and `y_true` is
+    shared (rows,) or per-model (M, rows).  Returns a DataTable with one
+    row per model (`model_name` + the same metric columns the serial
+    evaluator emits, union over binary/multiclass arities).  The metric
+    arithmetic is `_metrics_from_confusion`, shared with
+    `ComputeModelStatistics`, so values match per-model evaluation
+    exactly while the confusion matrices come from a single vectorized
+    scatter-add instead of M mml-tagged table round trips
+    (FindBestModel's candidate ranking; TrainClassifier's sweep path).
+    """
+    yp = np.asarray(y_pred_stack, np.int64)
+    cms = confusion_matrix_batch(y_true, yp, n_classes)
+    m = yp.shape[0]
+    yt = np.asarray(y_true, np.int64)
+    uids = list(model_uids) if model_uids is not None \
+        else [f"model_{i}" for i in range(m)]
+    if len(uids) != m:
+        raise ValueError(f"{len(uids)} model uids for {m} models")
+    rows = []
+    for i in range(m):
+        y_i = yt[i] if yt.ndim == 2 else yt
+        p_i = probs_stack[i] if probs_stack is not None else None
+        out, _ = _metrics_from_confusion(cms[i], y_i, p_i)
+        rows.append({"model_name": uids[i], **out})
+    cols: list[str] = []
+    for r in rows:
+        for key in r:
+            if key not in cols:
+                cols.append(key)
+    return DataTable({c: [r.get(c, np.nan) for r in rows] for c in cols})
 
 
 def classification_report(y_true, y_pred, model_uid: str = "model") -> EvalResult:

@@ -46,6 +46,17 @@ class TrainClassifier(Estimator):
     numFeatures = Param(0, "hash space size (0 = per-learner default, "
                         "Featurize.scala:13-19)", ptype=int)
     indexLabel = Param(True, "convert label to categorical indices", ptype=bool)
+    populationSize = Param(0, "when > 1 and the learner is the MLP, train a "
+                           "population of candidates at log-spaced learning "
+                           "rates around stepSize in ONE vmapped program "
+                           "(train/sweep.py) and keep the winner", ptype=int)
+    sweepLearningRates = Param(None, "explicit learning-rate grid for the "
+                               "population sweep (one member per rate; "
+                               "overrides populationSize)",
+                               ptype=(list, tuple))
+    sweepHalvingRungs = Param(0, "successive-halving rungs for the sweep "
+                              "(0 = train every member to completion)",
+                              ptype=int)
 
     def __init__(self, model: Optional[Estimator] = None, **kw):
         super().__init__(**kw)
@@ -105,11 +116,34 @@ class TrainClassifier(Estimator):
             learner = learner.copy(layers=layers)
 
         learner.set_params(featuresCol=self.featuresCol, labelCol=label)
-        fit_model = learner.fit(processed)
+        sweep_metrics = None
+        rates = self._sweep_rates(learner) if is_mlp else None
+        if rates:
+            # the population path: featurized ONCE above, then every
+            # candidate trains inside one vmapped program and the winner
+            # is picked by one batched evaluation (train/sweep.py)
+            fit_model, sweep_metrics = learner.fit_population(
+                processed, rates, int(self.sweepHalvingRungs))
+        else:
+            fit_model = learner.fit(processed)
         pipeline = PipelineModel([featurized_model, fit_model])
-        return TrainedClassifierModel(
+        model = TrainedClassifierModel(
             pipeline, levels=levels, labelCol=label,
             featuresCol=self.featuresCol)
+        model._sweep_metrics = sweep_metrics
+        return model
+
+    def _sweep_rates(self, learner) -> Optional[list]:
+        """The candidate learning-rate grid, or None for a plain fit:
+        an explicit sweepLearningRates list wins; populationSize > 1
+        log-spaces a decade either side of the learner's stepSize."""
+        if self.sweepLearningRates:
+            return [float(r) for r in self.sweepLearningRates]
+        n = int(self.populationSize)
+        if n <= 1:
+            return None
+        base = float(learner.stepSize)
+        return [float(r) for r in np.geomspace(base / 10.0, base * 10.0, n)]
 
     def _save_extra(self, path: str) -> None:
         if self._model is not None:
@@ -132,10 +166,18 @@ class TrainedClassifierModel(Transformer):
         super().__init__(**kw)
         self._pipeline = pipeline
         self._levels = list(levels) if levels is not None else None
+        self._sweep_metrics: Optional[DataTable] = None
 
     @property
     def levels(self) -> Optional[list]:
         return self._levels
+
+    @property
+    def sweep_metrics(self) -> Optional[DataTable]:
+        """Per-member metrics of the population sweep that produced this
+        model (one row per candidate learning rate), or None for a plain
+        fit."""
+        return self._sweep_metrics
 
     @property
     def featurized_model(self):
@@ -174,8 +216,13 @@ class TrainedClassifierModel(Transformer):
         self._pipeline.save(os.path.join(path, "pipeline"))
         with open(os.path.join(path, "levels.json"), "w") as f:
             json.dump(self._levels, f)
+        if self._sweep_metrics is not None:
+            self._sweep_metrics.save(os.path.join(path, "sweep_metrics"))
 
     def _load_extra(self, path: str) -> None:
         self._pipeline = load_stage(os.path.join(path, "pipeline"))
         with open(os.path.join(path, "levels.json")) as f:
             self._levels = json.load(f)
+        sm = os.path.join(path, "sweep_metrics")
+        self._sweep_metrics = DataTable.load(sm) if os.path.exists(sm) \
+            else None

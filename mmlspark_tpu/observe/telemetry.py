@@ -112,6 +112,11 @@ class RunTelemetry:
         # split dispatch/completion, worker death, re-dispatch, scaling —
         # what the data drill asserts its recovery invariants against
         self._data_service: list[dict] = []
+        # the run's population-sweep timeline (train/sweep.py): start,
+        # per-rung cull decisions (who, by what metric), per-member final
+        # losses, winner — the history store's per-member regression
+        # baselines read straight out of this
+        self._sweep: list[dict] = []
         # bounded-time cleanups run at finish() (e.g. stopping a metrics
         # server bound to this run) — never allowed to raise or hang the
         # run exit
@@ -274,6 +279,19 @@ class RunTelemetry:
         self.tracer._record({"type": "prefix",
                              "ts": round(self.tracer.now(), 6), **rec})
 
+    def record_sweep(self, event: dict) -> None:
+        """Append one population-sweep event (train/sweep.py: start,
+        rung cull, member final, winner) to the run's ordered timeline
+        (also streamed as a `sweep` record); the full list lands in
+        run_summary.json under `sweep`, giving the history store
+        per-member curves without any extra plumbing."""
+        if not self.live:
+            return
+        rec = dict(event)
+        self._sweep.append(rec)
+        self.tracer._record({"type": "sweep",
+                             "ts": round(self.tracer.now(), 6), **rec})
+
     def record_data_service(self, event: dict) -> None:
         """Append one data-service event (data/service/dispatcher.py) to
         the run's ordered timeline (also streamed as a `data_service`
@@ -327,6 +345,7 @@ class RunTelemetry:
             "handoff": [dict(e) for e in self._handoff],
             "prefix": [dict(e) for e in self._prefix],
             "data_service": [dict(e) for e in self._data_service],
+            "sweep": [dict(e) for e in self._sweep],
             "trace_records_dropped": self.tracer.dropped,
         }
 

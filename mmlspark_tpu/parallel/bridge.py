@@ -195,6 +195,26 @@ def replicate_tree(tree: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
 
 
+def stack_trees(trees: Sequence[Any]) -> Any:
+    """Stack N structurally-identical host pytrees on a new leading
+    population axis (train/sweep.py: member param/opt trees become ONE
+    tree whose leaves carry shape (N, ...), so a single vmapped step
+    trains every member).  Host-side by design — stacking happens once
+    at init/restore, before the tree is committed to devices."""
+    if not trees:
+        raise ValueError("stack_trees needs at least one tree")
+    return jax.tree_util.tree_map(
+        lambda *leaves: np.stack([np.asarray(l) for l in leaves]), *trees)
+
+
+def unstack_member(tree: Any, k: int) -> Any:
+    """Slice member `k` out of a population-stacked pytree, returning
+    host arrays of the member's unstacked shapes (the sweep winner's
+    tree, ready for an ordinary ModelBundle)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: np.asarray(jax.device_get(leaf))[k], tree)
+
+
 def device_to_host(x: Any, valid: Optional[int] = None) -> np.ndarray:
     """Fetch a (possibly sharded) device array back to host, trimming padding."""
     arr = np.asarray(jax.device_get(x))

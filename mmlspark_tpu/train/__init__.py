@@ -1,5 +1,7 @@
 from mmlspark_tpu.train.config import TrainerConfig
 from mmlspark_tpu.train.trainer import Trainer, TrainState
+from mmlspark_tpu.train.sweep import (PopulationState, PopulationTrainer,
+                                      SweepResult)
 from mmlspark_tpu.train.learner import TPULearner
 from mmlspark_tpu.train.supervisor import (RecoveryBudgetExceeded,
                                            RecoveryPolicy,

@@ -1,0 +1,666 @@
+"""Vectorized population training: N hyperparameter-sweep members in
+ONE compiled program.
+
+The auto-ML surface (`FindBestModel`/`TrainClassifier`) used to train
+candidates sequentially — each `fit` a tiny program leaving the MXU
+idle between dispatches, the TPU-era version of the reference spinning
+up one `mpiexec` per candidate (CNTKLearner.scala:52-162).  SparkNet's
+answer was to fan candidates across a cluster; the TPU-native answer is
+to stack every member's param/opt-state trees on a leading population
+axis, broadcast the shared data batch, and `vmap` the train step so all
+members advance inside one XLA program per step.
+
+Mechanics:
+
+  * member k's init RNG is `fold_in(key(seed_k), k)` — independent of
+    the population size, so a member's loss curve does not move when
+    other members are added or culled;
+  * per-member learning rates ride through `vmap` as traced scalars
+    into the SAME optax chain a plain `Trainer` builds
+    (train/trainer.py `build_optimizer`), keeping a member's update
+    arithmetic equivalent to an ordinary fit at that rate;
+  * successive halving culls trailing members at rung boundaries by a
+    per-member `active` mask: the update still runs but `jnp.where`
+    freezes a culled member's params/opt-state/batch-stats.  Shapes and
+    dtypes never change, so culling never recompiles and never
+    re-stacks;
+  * `vmap` sits OUTSIDE the `use_mesh`-scoped member step, composing
+    with the PR-12 partition registry: an underfilling member can still
+    shard over the 'model' axis, and the batch keeps its 'data'-axis
+    sharding with the population axis unconstrained.
+
+Single-controller by design: the sweep trains many small models on one
+process's mesh; multi-host jobs should shard the CANDIDATE GRID across
+hosts (one PopulationTrainer each), not one population across hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from mmlspark_tpu import config
+from mmlspark_tpu.models.bundle import ModelBundle, _to_plain
+from mmlspark_tpu.models.definitions import build_model
+from mmlspark_tpu.observe import get_logger
+from mmlspark_tpu.observe.spans import active_timings, monotonic, span_on
+from mmlspark_tpu.observe.telemetry import active_run
+from mmlspark_tpu.observe.trace import active_tracer, current_span_id
+from mmlspark_tpu.parallel.bridge import (put_like, put_sharded, put_tree,
+                                          put_tree_like, snapshot_tree,
+                                          stack_trees, unstack_member)
+from mmlspark_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, batch_sharding,
+                                        make_mesh, replicated)
+from mmlspark_tpu.parallel.partition import (named_sharding, rules_to_json,
+                                             use_mesh)
+from mmlspark_tpu.parallel.partition import DEFAULT_RULES
+from mmlspark_tpu.resilience.checkpoints import (checkpoint_name,
+                                                 latest_valid_checkpoint)
+from mmlspark_tpu.resilience.ckpt_writer import (CheckpointWriter,
+                                                 read_checkpoint)
+from mmlspark_tpu.train.config import TrainerConfig
+from mmlspark_tpu.train.trainer import (Trainer, _epoch_order, _make_loss,
+                                        _param_sharding_rule, build_optimizer)
+from jax.sharding import PartitionSpec as P
+
+SWEEP_HALVING_RUNGS = config.register(
+    "MMLSPARK_TPU_SWEEP_HALVING_RUNGS", 0, ptype=int,
+    doc="population training: successive-halving rung count (0 = no "
+        "culling; rungs split the step budget evenly, each culls the "
+        "trailing members by recent loss — train/sweep.py)")
+SWEEP_CULL_FRACTION = config.register(
+    "MMLSPARK_TPU_SWEEP_CULL_FRACTION", 0.5, ptype=float,
+    doc="population training: fraction of still-active members culled "
+        "at each halving rung (mask-frozen, never re-stacked)")
+SWEEP_MIN_ACTIVE = config.register(
+    "MMLSPARK_TPU_SWEEP_MIN_ACTIVE", 1, ptype=int,
+    doc="population training: floor of active members a halving rung "
+        "may not cull below")
+
+
+@struct.dataclass
+class PopulationState:
+    """A `TrainState` with a leading population axis on every tree leaf,
+    plus the per-member vmapped scalars (learning rate, active mask)."""
+    step: jax.Array        # scalar int32, shared — members step in lockstep
+    params: Any            # stacked: leaf shape (N, ...)
+    opt_state: Any         # stacked optax state
+    batch_stats: Any       # stacked ({} for stateless models)
+    lr: jax.Array          # (N,) float32 per-member learning rate
+    active: jax.Array      # (N,) float32 mask; 0 = culled (frozen)
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """What a population fit hands back: the final stacked state, every
+    member's loss curve, and the winner unstacked into a normal bundle."""
+    state: PopulationState
+    member_loss: np.ndarray          # (steps, N) per-step per-member loss
+    lrs: np.ndarray                  # (N,) the rates trained at
+    active: np.ndarray               # (N,) final mask (1 = survived)
+    best_member: int
+    _trainer: "PopulationTrainer"
+
+    @property
+    def population(self) -> int:
+        return int(self.lrs.shape[0])
+
+    def final_losses(self) -> np.ndarray:
+        """Each member's final-step training loss (culled members hold the
+        loss their frozen params still produce)."""
+        return self.member_loss[-1]
+
+    def member_bundle(self, k: int) -> ModelBundle:
+        return self._trainer.member_bundle(self.state, k)
+
+    def winner_bundle(self) -> ModelBundle:
+        return self.member_bundle(self.best_member)
+
+
+class PopulationTrainer:
+    """Trains a population of sweep members with one vmapped step.
+
+    `members` is either an int (population size N; every member gets the
+    config's learning rate — useful for seed sweeps) or a sequence of
+    per-member dicts, each accepting:
+
+        learning_rate  (default: config.learning_rate)
+        seed           (default: config.seed; the member's init key is
+                        fold_in(key(seed), member_id) either way)
+
+    The shared data batch, epoch ordering, and batch clamping follow
+    `Trainer.fit_arrays` exactly, so a member's step sequence matches
+    the plain trainer's at the same config.
+    """
+
+    def __init__(self, trainer_config: TrainerConfig,
+                 members: Union[int, Sequence[dict]], mesh=None,
+                 halving_rungs: Optional[int] = None,
+                 cull_fraction: Optional[float] = None,
+                 min_active: Optional[int] = None):
+        self.config = trainer_config
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "population training is single-controller; shard the "
+                "candidate grid across hosts, not one population")
+        if trainer_config.pipeline_stages > 1:
+            raise ValueError(
+                "population training does not compose with pipeline "
+                "parallelism (the stage ring owns the 'model' axis)")
+        if isinstance(members, int):
+            if members < 1:
+                raise ValueError("population size must be >= 1")
+            members = [{} for _ in range(members)]
+        self.members = [dict(m) for m in members]
+        if not self.members:
+            raise ValueError("population needs at least one member")
+        self.module = build_model(trainer_config.architecture,
+                                  trainer_config.model_config)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            trainer_config.mesh)
+        self._loss = _make_loss(trainer_config.loss)
+        import inspect
+        sig = inspect.signature(type(self.module).__call__)
+        self._has_train_arg = "train" in sig.parameters
+        self.halving_rungs = int(SWEEP_HALVING_RUNGS.current()
+                                 if halving_rungs is None else halving_rungs)
+        self.cull_fraction = float(SWEEP_CULL_FRACTION.current()
+                                   if cull_fraction is None
+                                   else cull_fraction)
+        self.min_active = int(SWEEP_MIN_ACTIVE.current()
+                              if min_active is None else min_active)
+        if not 0.0 < self.cull_fraction < 1.0:
+            raise ValueError("cull_fraction must be in (0, 1)")
+        self.history: list[dict] = []
+        self._writers: dict[str, CheckpointWriter] = {}
+
+    # -- init -----------------------------------------------------------
+    @property
+    def population(self) -> int:
+        return len(self.members)
+
+    def member_lr(self, k: int) -> float:
+        return float(self.members[k].get("learning_rate",
+                                         self.config.learning_rate))
+
+    def _member_key(self, k: int) -> jax.Array:
+        seed = int(self.members[k].get("seed", self.config.seed))
+        return jax.random.fold_in(jax.random.key(seed), k)
+
+    def member_init_variables(self, k: int, input_shape: tuple,
+                              input_dtype=np.float32) -> dict:
+        """Member k's fresh-init variables (host arrays) — the same tree
+        the population stacks at slot k, unstacked.  Parity harnesses
+        warm-start a plain Trainer from this to compare update
+        arithmetic without re-deriving the fold_in init."""
+        x = np.zeros(input_shape, input_dtype)
+        variables = _to_plain(self.module.init(self._member_key(k), x))
+        return jax.tree_util.tree_map(np.asarray, variables)
+
+    def member_init_bundle(self, k: int, input_shape: tuple,
+                           input_dtype=np.float32) -> ModelBundle:
+        """Member k's init as a warm-start bundle for a plain Trainer."""
+        return ModelBundle.from_module(
+            self.module,
+            self.member_init_variables(k, input_shape, input_dtype))
+
+    def _stacked_shardings(self, stacked_params):
+        """Per-leaf shardings for the population tree: the registry rule
+        on the UNSTACKED member shape, with the population axis prepended
+        unconstrained — a member sharded over 'model' stays sharded."""
+        rule = _param_sharding_rule(self.mesh, self.config.tensor_parallel,
+                                    self.config.expert_parallel,
+                                    getattr(self.config, "partition_rules",
+                                            None))
+
+        def stacked(path, leaf):
+            member = jax.ShapeDtypeStruct(np.shape(leaf)[1:],
+                                          np.asarray(leaf).dtype)
+            spec = rule(path, member).spec
+            return named_sharding(self.mesh, P(None, *spec))
+
+        return jax.tree_util.tree_map_with_path(stacked, stacked_params)
+
+    def init_state(self, input_shape: tuple, total_steps: int = 1,
+                   input_dtype=np.float32) -> PopulationState:
+        """Stack every member's fresh init (and eager optax init) into
+        one sharded PopulationState."""
+        n = self.population
+        tx = build_optimizer(self.config, total_steps)
+        params_list, stats_list, opt_list = [], [], []
+        for k in range(n):
+            variables = self.member_init_variables(k, input_shape,
+                                                   input_dtype)
+            params_list.append(variables["params"])
+            stats_list.append(variables.get("batch_stats", {}))
+            # optax init is lr-independent, so the host-side member init
+            # stacks exactly like params (counts collapse to equal scalars)
+            opt_list.append(jax.tree_util.tree_map(
+                np.asarray, jax.device_get(tx.init(variables["params"]))))
+        params = stack_trees(params_list)
+        opt_state = stack_trees(opt_list)
+        batch_stats = stack_trees(stats_list) if stats_list[0] else {}
+        # eager sharded placement, mirroring Trainer.init_state: params by
+        # the (population-prefixed) registry rule, everything else replicated
+        params = put_tree(params, self._stacked_shardings(params))
+        rep = replicated(self.mesh)
+        opt_state = put_tree(opt_state, jax.tree_util.tree_map(
+            lambda _: rep, opt_state))
+        batch_stats = put_tree(batch_stats, jax.tree_util.tree_map(
+            lambda _: rep, batch_stats))
+        lr = np.asarray([self.member_lr(k) for k in range(n)], np.float32)
+        active = np.ones(n, np.float32)
+        return PopulationState(
+            step=jnp.asarray(0, jnp.int32),
+            params=params, opt_state=opt_state, batch_stats=batch_stats,
+            lr=put_sharded(lr, rep), active=put_sharded(active, rep))
+
+    # -- the compiled step ----------------------------------------------
+    def make_population_step(self, total_steps: int):
+        """jit(vmap(member step)): one program advancing all N members.
+
+        The member step runs under `use_mesh`, so the module forward's
+        sharding constraints bake this mesh in; `vmap` wraps it from the
+        OUTSIDE with the data batch broadcast (in_axes=None) and the
+        member trees/scalars batched (in_axes=0)."""
+        module, loss_fn = self.module, self._loss
+        has_train = self._has_train_arg
+        cfg, mesh = self.config, self.mesh
+        aux_w = float(cfg.aux_loss_weight)
+
+        def member_step(params, opt_state, batch_stats, lr, active,
+                        x, y, mask):
+            # the same chain a plain Trainer builds, with this member's
+            # rate riding in as a traced scalar
+            tx = build_optimizer(cfg, total_steps, learning_rate=lr)
+
+            def compute(p):
+                variables = {"params": p}
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                if has_train:
+                    out, mut = module.apply(
+                        variables, x, train=True,
+                        mutable=["batch_stats", "losses", "metrics"])
+                    new_stats = mut.get("batch_stats", batch_stats)
+                else:
+                    out, mut = module.apply(variables, x,
+                                            mutable=["losses", "metrics"])
+                    new_stats = batch_stats
+                loss = loss_fn(out, y, mask)
+                if aux_w:
+                    loss = loss + aux_w * sum(
+                        jnp.asarray(v).sum() for v in
+                        jax.tree_util.tree_leaves(mut.get("losses", {})))
+                return loss, new_stats
+
+            (loss, new_stats), grads = \
+                jax.value_and_grad(compute, has_aux=True)(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # the halving freeze: a culled member still traces the same
+            # program (no recompile) but keeps its old state byte-for-byte
+            keep = active > 0
+
+            def freeze(new, old):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(keep, a, b), new, old)
+
+            return (freeze(new_params, params), freeze(new_opt, opt_state),
+                    freeze(new_stats, batch_stats), loss)
+
+        def meshed_member_step(*args):
+            with use_mesh(mesh):
+                return member_step(*args)
+
+        vmapped = jax.vmap(meshed_member_step,
+                           in_axes=(0, 0, 0, 0, 0, None, None, None))
+
+        def population_step(state: PopulationState, x, y, mask):
+            new_params, new_opt, new_stats, losses = vmapped(
+                state.params, state.opt_state, state.batch_stats,
+                state.lr, state.active, x, y, mask)
+            return PopulationState(
+                step=state.step + 1, params=new_params, opt_state=new_opt,
+                batch_stats=new_stats, lr=state.lr,
+                active=state.active), losses
+
+        return jax.jit(population_step, donate_argnums=(0,))
+
+    # -- scoring --------------------------------------------------------
+    def score_population(self, state: PopulationState,
+                         x: np.ndarray) -> np.ndarray:
+        """Stacked inference logits, shape (N, rows, ...): ONE vmapped
+        forward scores every member — the batched candidate evaluation
+        FindBestModel feeds to `classification_report_batch` instead of
+        N transform round-trips."""
+        module, mesh = self.module, self.mesh
+        has_train = self._has_train_arg
+
+        def member_apply(params, batch_stats, xb):
+            with use_mesh(mesh):
+                variables = {"params": params}
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                if has_train:
+                    return module.apply(variables, xb, train=False)
+                return module.apply(variables, xb)
+
+        fn = jax.jit(jax.vmap(member_apply, in_axes=(0, 0, None)))
+        xb = put_sharded(np.asarray(x), batch_sharding(self.mesh))
+        return np.asarray(jax.device_get(
+            fn(state.params, state.batch_stats, xb)))
+
+    # -- the loop --------------------------------------------------------
+    def fit_arrays(self, x: np.ndarray, y: np.ndarray,
+                   ckpt_dir: Optional[str] = None,
+                   resume: bool = False) -> SweepResult:
+        """Train the whole population on shared data; returns the final
+        stacked state plus per-member loss curves and the winner.
+
+        Data order, batch clamping, and the rng stream are identical to
+        `Trainer.fit_arrays` at the same config, so curves line up with
+        plain fits.  `ckpt_dir` + config.checkpoint_every_steps write
+        rotation checkpoints of the WHOLE population (one file, stacked
+        trees + lr + active); `resume=True` restarts a mid-sweep
+        population from the newest valid one, replaying the same data
+        order and skipping completed steps.
+        """
+        cfg = self.config
+        n_pop = self.population
+        ckpt_dir = ckpt_dir if ckpt_dir is not None else cfg.checkpoint_dir
+        n = len(x)
+        data_size = self.mesh.shape[DATA_AXIS]
+        bs = cfg.batch_size
+        bs = max(bs - bs % data_size, data_size)
+        steps_per_epoch = max(1, (n + bs - 1) // bs)
+        total_steps = steps_per_epoch * cfg.epochs
+        self._effective_batch_size = bs
+
+        state = self.init_state((1,) + x.shape[1:], total_steps,
+                                input_dtype=np.asarray(x).dtype)
+        skip_until = 0
+        if resume and ckpt_dir and \
+                latest_valid_checkpoint(ckpt_dir) is not None:
+            state = self.restore_checkpoint(state, ckpt_dir)
+            skip_until = int(state.step)
+            get_logger("train").info(
+                "sweep resuming from checkpoint at step %d", skip_until)
+        step_fn = self.make_population_step(total_steps)
+        x_sh = batch_sharding(self.mesh)
+        rng = np.random.default_rng(cfg.seed)
+
+        # rung boundaries: the step budget split evenly across rungs, the
+        # last boundary strictly before the end so the final span trains
+        # the survivors
+        rungs = []
+        if self.halving_rungs > 0:
+            span = total_steps / (self.halving_rungs + 1)
+            rungs = sorted({int(span * (i + 1))
+                            for i in range(self.halving_rungs)})
+            rungs = [r for r in rungs if 0 < r < total_steps]
+
+        tracer = active_tracer()
+        run = active_run()
+        timings = active_timings()
+        fit_span = tracer.span(
+            "sweep.fit", parent=current_span_id(), cat="phase",
+            architecture=cfg.architecture, population=n_pop,
+            total_steps=total_steps, batch_size=bs,
+            halving_rungs=len(rungs)) if tracer is not None else None
+        fit_id = fit_span.span_id if fit_span is not None else None
+        if run is not None:
+            run.record_sweep({
+                "event": "start", "population": n_pop,
+                "total_steps": total_steps,
+                "lrs": [self.member_lr(k) for k in range(n_pop)],
+                "rungs": rungs, "resumed_at": skip_until})
+
+        t0 = monotonic()
+        active_host = np.asarray(jax.device_get(state.active), np.float32)
+        loss_rows: list = []        # one (N,) device array per executed step
+        rung_start = 0              # index into loss_rows of the rung window
+        epoch_losses: list = []
+        cur_epoch = -1
+        first_exec = True
+
+        def finish_epoch(epoch: int) -> None:
+            if epoch < 0 or not epoch_losses:
+                return
+            fetched = np.asarray(jax.device_get(epoch_losses), np.float32)
+            mean = fetched.mean(axis=0)  # (N,)
+            act = active_host > 0
+            rec = {"epoch": epoch,
+                   "loss": float(mean[act].mean()) if act.any()
+                   else float(mean.mean()),
+                   "member_loss": [float(v) for v in mean],
+                   "wall_s": monotonic() - t0}
+            self.history.append(rec)
+
+        def cull(step_c: int) -> None:
+            """One halving rung: rank active members by their mean loss
+            since the previous rung; freeze the trailing cull_fraction."""
+            nonlocal active_host, rung_start, state
+            window = loss_rows[rung_start:]
+            rung_start = len(loss_rows)
+            if not window:
+                return
+            mean = np.asarray(jax.device_get(window),
+                              np.float32).mean(axis=0)
+            alive = np.flatnonzero(active_host > 0)
+            n_keep = max(self.min_active,
+                         len(alive) - max(1, int(len(alive)
+                                                 * self.cull_fraction)))
+            if n_keep >= len(alive):
+                return
+            order = alive[np.argsort(mean[alive], kind="stable")]
+            culled = order[n_keep:]
+            active_host = active_host.copy()
+            active_host[culled] = 0.0
+            # same shape/dtype/sharding → the compiled step is reused
+            state = state.replace(
+                active=put_like(active_host, state.active))
+            if run is not None:
+                run.record_sweep({
+                    "event": "cull", "step": step_c,
+                    "culled": [int(c) for c in culled],
+                    "survivors": [int(s) for s in order[:n_keep]],
+                    "window_loss": [float(v) for v in mean]})
+            get_logger("train").info(
+                "sweep rung at step %d: culled members %s (%d survive)",
+                step_c, [int(c) for c in culled], n_keep)
+
+        step_c = 0
+        for epoch in range(cfg.epochs):
+            order = _epoch_order(rng, epoch, n, n, cfg.shuffle_each_epoch)
+            if epoch != cur_epoch:
+                finish_epoch(cur_epoch)
+                cur_epoch = epoch
+                epoch_losses = []
+            for start in range(0, n, bs):
+                if step_c < skip_until:
+                    # completed before the checkpoint being resumed; the
+                    # rng stream above still advanced identically, and a
+                    # rung crossed before the save already took effect in
+                    # the restored active mask
+                    step_c += 1
+                    if step_c in rungs:
+                        rung_start = len(loss_rows)
+                    continue
+                with span_on(timings, "host"):
+                    idx = order[start:start + bs]
+                    valid = len(idx)
+                    if valid < bs:
+                        idx = np.concatenate(
+                            [idx, np.resize(order, bs - valid)])
+                    mask = np.zeros(bs, np.float32)
+                    mask[:valid] = 1.0
+                    xh, yh = x[idx], y[idx]
+                with span_on(timings, "transfer"):
+                    xb = put_sharded(xh, x_sh)
+                    yb = put_sharded(yh, x_sh)
+                    mask_d = put_sharded(mask, x_sh)
+                if tracer is None:
+                    with span_on(timings, "compute"):
+                        state, losses = step_fn(state, xb, yb, mask_d)
+                else:
+                    with tracer.span(
+                            "train.step", parent=fit_id, cat="step",
+                            step=step_c, epoch=epoch, population=n_pop,
+                            first_step_compile=first_exec) as sp, \
+                            span_on(timings, "compute"):
+                        state, losses = step_fn(state, xb, yb, mask_d)
+                        fetched = np.asarray(jax.device_get(losses),
+                                             np.float32)
+                        act = active_host > 0
+                        sp.attrs["loss"] = float(
+                            fetched[act].mean() if act.any()
+                            else fetched.mean())
+                        sp.attrs["member_loss"] = [
+                            round(float(v), 6) for v in fetched]
+                        sp.attrs["active_members"] = int(act.sum())
+                first_exec = False
+                loss_rows.append(losses)
+                epoch_losses.append(losses)
+                step_c += 1
+                if step_c in rungs:
+                    cull(step_c)
+                if ckpt_dir and cfg.checkpoint_every_steps and \
+                        step_c % cfg.checkpoint_every_steps == 0:
+                    self.save_checkpoint(
+                        state, ckpt_dir, step=step_c,
+                        sync=not cfg.async_checkpointing)
+        finish_epoch(cur_epoch)
+        self._close_writers()
+        if ckpt_dir:
+            self.save_checkpoint(state, ckpt_dir, sync=True)
+            self._close_writers()
+
+        member_loss = np.asarray(jax.device_get(loss_rows), np.float32) \
+            if loss_rows else np.zeros((0, n_pop), np.float32)
+        # winner: best mean loss over the final epoch among survivors
+        tail = member_loss[-max(1, steps_per_epoch):] if len(member_loss) \
+            else np.zeros((1, n_pop), np.float32)
+        tail_mean = tail.mean(axis=0)
+        ranked = np.where(active_host > 0, tail_mean, np.inf)
+        best = int(np.argmin(ranked))
+        if run is not None:
+            for k in range(n_pop):
+                run.record_sweep({
+                    "event": "member_final", "member": k,
+                    "lr": self.member_lr(k),
+                    "active": bool(active_host[k] > 0),
+                    "final_loss": float(member_loss[-1, k])
+                    if len(member_loss) else None})
+            run.record_sweep({"event": "winner", "member": best,
+                              "final_loss": float(tail_mean[best])})
+        if fit_span is not None:
+            fit_span.attrs["winner"] = best
+            fit_span.finish()
+        self._last_state = state
+        return SweepResult(state=state, member_loss=member_loss,
+                           lrs=np.asarray([self.member_lr(k)
+                                           for k in range(n_pop)],
+                                          np.float32),
+                           active=active_host.copy(), best_member=best,
+                           _trainer=self)
+
+    # -- unstacking ------------------------------------------------------
+    def member_bundle(self, state: PopulationState, k: int) -> ModelBundle:
+        """Slice member k out of the stacked state into an ordinary
+        ModelBundle — loadable by `TPUModel`, fine-tunable by `Trainer`,
+        indistinguishable from a sequentially-trained model."""
+        variables = {"params": unstack_member(state.params, k)}
+        if state.batch_stats:
+            variables["batch_stats"] = unstack_member(state.batch_stats, k)
+        rules = getattr(self.config, "partition_rules", None) \
+            or DEFAULT_RULES
+        metadata = {
+            "steps": int(state.step),
+            "sweep": {"member": int(k), "population": self.population,
+                      "learning_rate": self.member_lr(k)},
+            "partition": {
+                "rules": rules_to_json(rules),
+                "mesh": {"data": int(self.mesh.shape.get(DATA_AXIS, 1)),
+                         "model": int(self.mesh.shape.get(MODEL_AXIS, 1))},
+            },
+        }
+        return ModelBundle.from_module(self.module, variables,
+                                       metadata=metadata)
+
+    def member_trainer(self, k: int) -> Trainer:
+        """A plain Trainer configured exactly as member k (its learning
+        rate and seed) — the sequential half of parity checks."""
+        cfg = dataclasses.replace(
+            self.config,
+            learning_rate=self.member_lr(k),
+            seed=int(self.members[k].get("seed", self.config.seed)))
+        return Trainer(cfg, mesh=self.mesh)
+
+    # -- checkpoint / resume ---------------------------------------------
+    def _writer_for(self, ckpt_dir: str) -> CheckpointWriter:
+        writer = self._writers.get(ckpt_dir)
+        if writer is None:
+            writer = self._writers[ckpt_dir] = CheckpointWriter(ckpt_dir)
+        return writer
+
+    def _close_writers(self) -> None:
+        for writer in self._writers.values():
+            writer.close(best_effort=True)
+        self._writers.clear()
+
+    def _state_tree(self, state: PopulationState) -> dict:
+        return {"step": state.step, "params": state.params,
+                "opt_state": state.opt_state,
+                "batch_stats": state.batch_stats,
+                "lr": state.lr, "active": state.active}
+
+    def save_checkpoint(self, state: PopulationState, ckpt_dir: str, *,
+                        step: Optional[int] = None,
+                        sync: bool = True) -> str:
+        """One rotation checkpoint of the WHOLE population (stacked trees
+        + lr + active mask in a single file), riding the background
+        writer exactly like Trainer.save_checkpoint."""
+        dev = snapshot_tree(self._state_tree(state))
+        step = int(state.step) if step is None else int(step)
+        meta = {"step": step, "population": self.population,
+                "effective_batch_size": getattr(
+                    self, "_effective_batch_size", None),
+                "seed": int(self.config.seed), "sweep": True, "format": 1}
+        path = self._writer_for(ckpt_dir).submit(step, dev, meta=meta,
+                                                 sync=sync)
+        return path if path else os.path.join(ckpt_dir,
+                                              checkpoint_name(step))
+
+    def restore_checkpoint(self, state: PopulationState,
+                           ckpt_dir: str) -> PopulationState:
+        """Restore a mid-sweep population from the newest valid
+        checkpoint: stacked arrays re-committed onto the live state's
+        shardings (put_tree_like), the active mask included — culls that
+        happened before the save stay culled after the resume."""
+        path = latest_valid_checkpoint(ckpt_dir)
+        if path is None:
+            raise FileNotFoundError(f"no valid checkpoint in {ckpt_dir}")
+        template = jax.tree_util.tree_map(
+            lambda a: np.zeros(np.shape(a), a.dtype),
+            self._state_tree(state))
+        restored = read_checkpoint(template, path)
+        return PopulationState(
+            step=put_like(jnp.asarray(restored["step"], jnp.int32),
+                          state.step, mesh=self.mesh),
+            params=put_tree_like(restored["params"], state.params,
+                                 mesh=self.mesh),
+            opt_state=put_tree_like(restored["opt_state"], state.opt_state,
+                                    mesh=self.mesh),
+            batch_stats=put_tree_like(restored["batch_stats"],
+                                      state.batch_stats, mesh=self.mesh),
+            lr=put_like(restored["lr"], state.lr, mesh=self.mesh),
+            active=put_like(restored["active"], state.active,
+                            mesh=self.mesh))

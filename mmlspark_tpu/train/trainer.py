@@ -119,6 +119,41 @@ def _param_sharding_rule(mesh, tensor_parallel: bool,
     return rule
 
 
+def build_optimizer(cfg: TrainerConfig, total_steps: int,
+                    learning_rate=None) -> optax.GradientTransformation:
+    """The config's optax chain.  `learning_rate` overrides the config's
+    base rate and may be a TRACED scalar — the population trainer
+    (train/sweep.py) passes each sweep member's rate through `vmap`, so
+    one compiled step trains N members at N different learning rates.
+    The chain structure is identical either way, which is what makes a
+    vmapped member's update arithmetic byte-compatible with a plain
+    Trainer fit at the same rate."""
+    base = cfg.learning_rate if learning_rate is None else learning_rate
+    if cfg.lr_schedule == "constant":
+        lr = base
+    elif cfg.lr_schedule == "cosine":
+        lr = optax.cosine_decay_schedule(base, max(total_steps, 1))
+    elif cfg.lr_schedule == "warmup_cosine":
+        lr = optax.warmup_cosine_decay_schedule(
+            0.0, base, cfg.warmup_steps,
+            max(total_steps, cfg.warmup_steps + 1))
+    else:
+        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule}")
+    if cfg.optimizer == "sgd":
+        tx = optax.sgd(lr)
+    elif cfg.optimizer == "momentum":
+        tx = optax.sgd(lr, momentum=cfg.momentum)
+    elif cfg.optimizer == "adam":
+        tx = optax.adam(lr)
+    else:
+        tx = optax.adamw(lr, weight_decay=cfg.weight_decay)
+    if cfg.optimizer != "adamw" and cfg.weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
+    if cfg.gradient_clip_norm:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.gradient_clip_norm), tx)
+    return tx
+
+
 def _make_loss(kind: str) -> Callable:
     def loss_fn(logits, labels, mask):
         mask = mask.astype(jnp.float32)
@@ -232,31 +267,7 @@ class Trainer:
 
     # -- optimizer ------------------------------------------------------
     def _build_optimizer(self, total_steps: int) -> optax.GradientTransformation:
-        cfg = self.config
-        if cfg.lr_schedule == "constant":
-            lr = cfg.learning_rate
-        elif cfg.lr_schedule == "cosine":
-            lr = optax.cosine_decay_schedule(cfg.learning_rate,
-                                             max(total_steps, 1))
-        elif cfg.lr_schedule == "warmup_cosine":
-            lr = optax.warmup_cosine_decay_schedule(
-                0.0, cfg.learning_rate, cfg.warmup_steps,
-                max(total_steps, cfg.warmup_steps + 1))
-        else:
-            raise ValueError(f"unknown lr_schedule {cfg.lr_schedule}")
-        if cfg.optimizer == "sgd":
-            tx = optax.sgd(lr)
-        elif cfg.optimizer == "momentum":
-            tx = optax.sgd(lr, momentum=cfg.momentum)
-        elif cfg.optimizer == "adam":
-            tx = optax.adam(lr)
-        else:
-            tx = optax.adamw(lr, weight_decay=cfg.weight_decay)
-        if cfg.optimizer != "adamw" and cfg.weight_decay:
-            tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
-        if cfg.gradient_clip_norm:
-            tx = optax.chain(optax.clip_by_global_norm(cfg.gradient_clip_norm), tx)
-        return tx
+        return build_optimizer(self.config, total_steps)
 
     # -- state ----------------------------------------------------------
     def init_state(self, input_shape: tuple, total_steps: int = 1,

@@ -20,6 +20,12 @@ echo "== data-layer contracts (Dataset graph + autotuner) =="
 # the full suite spends minutes exercising everything built on top of it
 python -m pytest tests/test_data.py -q
 
+echo "== population-sweep contracts (vmapped parity + halving) =="
+# same rationale: the vmapped train step must equal the Trainer's update
+# arithmetic before anything downstream (FindBestModel, bench gates)
+# interprets its losses
+python -m pytest tests/test_sweep.py -q
+
 echo "== test suite (8-virtual-device CPU mesh) =="
 # fast tier by default (pyproject addopts deselects `slow`); --full runs
 # everything, including the XLA-compile-bound parity tests and example/

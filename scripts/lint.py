@@ -89,6 +89,9 @@ HOT_LOOP_FILES = {
     os.path.join("mmlspark_tpu", "models", "generate.py"),
     os.path.join("mmlspark_tpu", "train", "trainer.py"),
     os.path.join("mmlspark_tpu", "train", "learner.py"),
+    # the vmapped population step dispatches once per sweep step for ALL
+    # members — a stray device_put or host clock here costs every member
+    os.path.join("mmlspark_tpu", "train", "sweep.py"),
     os.path.join("mmlspark_tpu", "stages", "basic.py"),
     os.path.join("mmlspark_tpu", "io", "image_reader.py"),
     os.path.join("mmlspark_tpu", "io", "files.py"),

@@ -320,6 +320,7 @@ def test_engine_reuse_byte_exact_whole_join(bundle):
     assert eng.stats()["prefix"]["hits"] == stats["hits"]
 
 
+@pytest.mark.slow  # tier-1 pin: the whole-join byte-exact variant
 def test_engine_reuse_byte_exact_chunked_resume(bundle):
     """A 40-token prompt sharing two 16-token chunks with a resident
     donor resumes CHUNKED prefill at offset 32 — and must match a

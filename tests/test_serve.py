@@ -360,6 +360,7 @@ def test_breaker_open_sheds_without_degraded(bundle):
     assert e.value.retry_after_s > 0
 
 
+@pytest.mark.slow
 def test_breaker_open_fails_over_to_degraded(bundle):
     from mmlspark_tpu.quant import quantize_bundle
     deg_bundle = quantize_bundle(bundle, "int8")

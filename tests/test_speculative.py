@@ -110,6 +110,9 @@ def test_soften_late_blocks_zeroes_projections(target):
 
 # ------------------------------------ greedy byte-exactness (the pin) ---
 
+# slow tier (with the _slow grid below): each cell is tens of seconds of
+# XLA on the CI box; tier-1 keeps the textgenerator parity + plumbing pins
+@pytest.mark.slow
 @pytest.mark.parametrize("chunk,cache_dtype,k", [
     (8, "model", 3), (8, "int8", 4)])
 def test_spec_greedy_byte_exact(target, draft, chunk, cache_dtype, k):
@@ -142,6 +145,7 @@ def test_spec_greedy_byte_exact_slow(target, draft, chunk, cache_dtype,
     test_spec_greedy_byte_exact(target, draft, chunk, cache_dtype, k)
 
 
+@pytest.mark.slow
 def test_spec_greedy_exact_with_stops_and_floor(target, draft):
     """Stops + min_new_tokens compose with speculation: the spec engine
     freezes on the same token at the same index as the plain engine."""
@@ -222,6 +226,7 @@ def test_spec_sampler_preserves_target_distribution(target, draft):
 
 # ------------------------------------------- chunked prefill parity ---
 
+@pytest.mark.slow  # tier-1 pin: test_textgenerator_prefill_chunk_parity
 @pytest.mark.parametrize("cache_dtype", ["model", "int8"])
 def test_chunked_prefill_parity(target, cache_dtype):
     """Chunked prefill is pure scheduling: outputs are byte-identical to
@@ -240,6 +245,7 @@ def test_chunked_prefill_parity(target, cache_dtype):
         np.testing.assert_array_equal(g, w)
 
 
+@pytest.mark.slow
 def test_chunked_prefill_composes_with_speculation(target, draft):
     module = target.module()
     rows = _ragged_rows([5, 18], seed=6)

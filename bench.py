@@ -81,6 +81,10 @@ CONTRACT_FIELDS = {
         "int8_kv_windowed_step_ms", "int8_kv_greedy_agreement",
         "kv_bytes_per_step", "windowed_kv_bytes_per_step",
         "int8_kv_bytes_per_step", "hbm_bw_util"}),
+    "lm_long_context": frozenset({
+        "metric", "value", "unit", "vs_baseline", "batch",
+        "context_len", "max_new", "prefill_wall_seq1_s",
+        "decode_step_seq1_ms"}),
     "serve": frozenset({
         "metric", "value", "unit", "vs_baseline",
         "continuous_goodput_tokens_per_sec",
@@ -1508,6 +1512,135 @@ def bench_lm_tensor_parallel(smoke: bool) -> dict:
     return out
 
 
+def bench_lm_long_context(smoke: bool) -> dict:
+    """Seq-sharded long-context decode arms (models/generate.py with a
+    mesh whose 'seq' axis > 1; docs/performance.md "Long-context
+    inference").
+
+    1. BASELINE (any device count): single-chip prefill wall + steady
+       decode-step time on a long prompt, from the engine's own
+       pipeline spans — the denominator every seq claim divides by.
+    2. SEQ=2 (2+ devices): the SAME prompt through a seq=2 engine —
+       distributed blockwise ring prefill wall, merged-stats decode
+       step, and the greedy token-parity gate (sharding is layout,
+       never arithmetic).  On the CPU smoke mesh the speedup is
+       informational (ppermute over shared memory); >= ~1.5x is the
+       real-TPU expectation at 8k context.
+    3. OOM-AT-SEQ1 (real TPU only): size the KV window past one chip's
+       HBM from memory_stats, confirm the whole-window engine OOMs
+       where seq=2 (half the window per chip) fits — the capability
+       claim sequence sharding is FOR.  Skips with a reason on
+       backends without memory_stats.
+    """
+    import jax
+
+    from mmlspark_tpu.models.definitions import build_model
+    from mmlspark_tpu.models.generate import DecodeEngine
+    from mmlspark_tpu.observe.spans import pipeline_timing
+    from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    if smoke:
+        cfg = {"vocab_size": 128, "d_model": 64, "n_heads": 4,
+               "n_layers": 2, "max_len": 320}
+        ctx, max_new, batch, chunk = 256, 8, 2, 32
+    else:
+        cfg = {"vocab_size": 8192, "d_model": 512, "n_heads": 8,
+               "n_layers": 4, "max_len": 8448}
+        ctx, max_new, batch, chunk = 8192, 32, 2, 256
+
+    module = build_model("TransformerLM", cfg)
+    variables = module.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg["vocab_size"], (batch, ctx)).astype(np.int32)
+    true_len = np.full((batch,), ctx, np.int32)
+
+    def run(mesh):
+        eng = DecodeEngine(module, max_new_tokens=max_new,
+                           temperature=0.0, chunk=chunk, mesh=mesh)
+        eng.generate(variables, toks, true_len)  # compile + warm
+        with pipeline_timing() as spans:
+            tokens = eng.generate(variables, toks, true_len)
+        return (np.asarray(tokens), spans.seconds.get("prefill", 0.0),
+                spans.seconds.get("decode", 0.0))
+
+    tok1, pf1, dec1 = run(None)
+    out = {
+        "metric": "transformer_lm_long_context_prefill_tokens_per_sec",
+        "value": round(batch * ctx / pf1, 1) if pf1 else None,
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # the reference has no long-context path
+        "batch": batch,
+        "context_len": ctx,
+        "max_new": max_new,
+        "prefill_wall_seq1_s": round(pf1, 4),
+        "decode_step_seq1_ms": round(dec1 / max_new * 1e3, 3),
+    }
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        out["seq_arm_skip_reason"] = (
+            "fewer than 2 devices: a ('data','model','seq') mesh needs "
+            "at least seq=2")
+        out["oom_seq1_skip_reason"] = out["seq_arm_skip_reason"]
+        return out
+
+    # -- arm 2: the same workload on a seq=2 mesh, parity-gated ----------
+    seq_mesh = make_mesh(MeshSpec(data=1, model=1, seq=2),
+                         jax.devices()[:2])
+    tok2, pf2, dec2 = run(seq_mesh)
+    out["prefill_wall_seq2_s"] = round(pf2, 4)
+    out["decode_step_seq2_ms"] = round(dec2 / max_new * 1e3, 3)
+    out["prefill_seq_speedup"] = round(pf1 / pf2, 3) if pf2 else None
+    out["tokens_match"] = bool(np.array_equal(tok1, tok2))
+
+    # -- arm 3: OOM at seq=1, fits at seq=2 (real-TPU capability) --------
+    dev0 = jax.devices()[0]
+    stats = getattr(dev0, "memory_stats", lambda: None)()
+    if dev0.platform != "tpu" or not stats or "bytes_limit" not in stats:
+        out["oom_seq1_skip_reason"] = (
+            f"backend {dev0.platform!r} exposes no HBM bytes_limit; the "
+            "OOM-at-seq1 arm needs a real TPU memory ceiling")
+        return out
+    try:
+        # size the KV window so the whole-window cache (K+V rows, model
+        # dtype f32 here) overflows ONE chip but halves under seq=2
+        limit = int(stats["bytes_limit"])
+        d_big, layers_big, chunk_big = 512, 4, 1024
+        slot_bytes = 2 * layers_big * d_big * 4
+        win = int(1.5 * limit / slot_bytes) // chunk_big * chunk_big
+        big = {"vocab_size": 8192, "d_model": d_big, "n_heads": 8,
+               "n_layers": layers_big, "max_len": win + chunk_big}
+        big_model = build_model("TransformerLM", big)
+        big_vars = big_model.init(jax.random.key(1),
+                                  np.zeros((1, 8), np.int32))
+        big_toks = rng.integers(0, big["vocab_size"],
+                                (1, win)).astype(np.int32)
+        big_len = np.full((1,), win, np.int32)
+
+        def try_prefill(mesh):
+            eng = DecodeEngine(big_model, max_new_tokens=2,
+                               temperature=0.0, chunk=chunk_big,
+                               mesh=mesh)
+            jax.block_until_ready(
+                eng.generate(big_vars, big_toks, big_len))
+
+        oom = False
+        try:
+            try_prefill(None)
+        except Exception as e:  # RESOURCE_EXHAUSTED -> XlaRuntimeError
+            oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+            if not oom:
+                raise
+        out["oom_seq1_only"] = oom
+        try_prefill(seq_mesh)
+        out["oom_seq2_fits"] = True
+        out["oom_window_slots"] = win
+    except Exception as e:
+        out["oom_seq1_skip_reason"] = (
+            f"OOM arm failed: {type(e).__name__}: {e}")
+    return out
+
+
 def bench_serve(smoke: bool) -> dict:
     """Online-serving arm (serve/): robustness claims, measured.
 
@@ -1986,6 +2119,9 @@ def main():
     # tensor-parallel arms: registry rule/gather pins (every backend),
     # mp=2 train/decode vs dp-only (2+ devices), OOM-at-dp-only (TPU)
     print(json.dumps(bench_lm_tensor_parallel(args.smoke)), flush=True)
+    # seq-sharded long-context decode: distributed blockwise prefill +
+    # seq-partitioned KV cache vs the single-chip engine, parity-gated
+    print(json.dumps(bench_lm_long_context(args.smoke)), flush=True)
     # online-serving robustness claims: continuous-batching goodput vs
     # static batches, overload shedding, corruption gate
     print(json.dumps(bench_serve(args.smoke)), flush=True)

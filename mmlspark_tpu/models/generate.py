@@ -83,11 +83,14 @@ from mmlspark_tpu.observe.costmodel import capture_program_cost
 from mmlspark_tpu.observe.spans import active_timings, span_on
 from mmlspark_tpu.observe.telemetry import active_run
 from mmlspark_tpu.observe.trace import trace_event, trace_span
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from mmlspark_tpu.parallel.partition import (
     DRAFT_KV_CACHE_SPEC,
     DRAFT_KV_SCALE_SPEC,
     KV_CACHE_SPEC,
     KV_SCALE_SPEC,
+    SEQ_KV_CACHE_SPEC,
+    SEQ_KV_SCALE_SPEC,
     shard_constraint,
     use_mesh,
 )
@@ -125,6 +128,20 @@ def _hint_draft_kv(c: jax.Array) -> jax.Array:
         return shard_constraint(c, DRAFT_KV_CACHE_SPEC)
     if c.ndim == 3:
         return shard_constraint(c, DRAFT_KV_SCALE_SPEC)
+    return c
+
+
+def _hint_seq_kv(c: jax.Array) -> jax.Array:
+    """`_hint_kv` for a SEQ-SHARDED cache: the WINDOW axis splits over
+    'seq' (SEQ_KV_CACHE_SPEC / SEQ_KV_SCALE_SPEC) so each chip holds a
+    contiguous slab of cache slots — the long-context layout where one
+    chip's HBM no longer bounds the window.  Heads stay unsharded (the
+    seq engine path refuses model>1 meshes).  Off-mesh the hint is
+    identity, same as every other KV hint."""
+    if c.ndim == 4:
+        return shard_constraint(c, SEQ_KV_CACHE_SPEC)
+    if c.ndim == 3:
+        return shard_constraint(c, SEQ_KV_SCALE_SPEC)
     return c
 
 
@@ -245,6 +262,35 @@ def _forward_with_cache(params: dict, tokens: jax.Array, caches: list,
     x = _ln(params["final_norm_w"], x, dtype)
     logits = _dense(params["lm_head"], x, dtype).astype(jnp.float32)
     return logits, new_caches
+
+
+def _seq_prefill_block(module, bp: dict, x: jax.Array, dtype,
+                       seq_axis: str):
+    """One TransformerBlock of the DISTRIBUTED blockwise prefill.  Runs
+    inside the seq shard_map region with `x` the LOCAL token slab
+    (B, P/n, D): attention is `ring_attention` — KV blocks rotate around
+    the `seq` axis by ppermute while each chip keeps only its slab's
+    queries resident — so prefill FLOPs, activation memory, and the
+    O(P^2) score working set all scale ~1/n per chip.  Returns the
+    residual stream plus this slab's K and V: the local shard of the
+    layer's seq-partitioned KV cache, written exactly once with no
+    gather."""
+    from mmlspark_tpu.ops.attention import ring_attention
+    n_heads = module.n_heads
+    b, s, d = x.shape
+    dh = d // n_heads
+    h = _ln(bp["LayerNorm_0"], x, dtype)
+    qkv = _dense(bp["qkv"], h, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, s, n_heads, dh)
+    q, k, v = (t.reshape(shape) for t in (q, k, v))
+    # ring_attention derives each block's global query positions from
+    # axis_index(seq_axis) internally, so causal masking is globally
+    # correct over the rotating KV blocks; output is f32 (online softmax)
+    o = ring_attention(q, k, v, seq_axis, causal=True)
+    x = x + _dense(bp["proj"], o.reshape(b, s, d).astype(dtype), dtype)
+    h2 = _ln(bp["LayerNorm_1"], x, dtype)
+    return x + _mlp(module, bp, h2, dtype), k, v
 
 
 def _check_generatable(module) -> None:
@@ -658,6 +704,93 @@ def _decode_step(params: dict, tok: jax.Array, pos: jax.Array, slot,
     return logits[:, 0], new_caches
 
 
+def _seq_decode_block(module, bp: dict, x: jax.Array, cache: tuple,
+                      slot, lo, visible, dtype, cache_kind: str,
+                      seq_axis: str):
+    """`_decode_block` for a SEQ-SHARDED cache, running inside the seq
+    shard_map region.  Each chip holds a contiguous window slab of `w_l`
+    slots starting at its `lo = axis_index(seq) * w_l`; the new token's
+    K/V land on exactly the one chip that owns global `slot` (`owns` is
+    a traced scalar — every chip computes the candidate write, the
+    non-owners discard it via `jnp.where`, so no cross-chip writes ever
+    happen).  Attention reads become per-chip softmax STATS
+    (`single_query_attention_stats`: f32 running (acc, m, l) against the
+    local slab under the local slice of `visible`) merged across `seq`
+    by `merge_attention_stats` — one pmax + two psums per layer instead
+    of gathering the window.  int8 dequant scales compose unchanged:
+    dequantization happens inside the local stats pass, before the
+    merge."""
+    from mmlspark_tpu.ops.attention import (merge_attention_stats,
+                                            single_query_attention_stats)
+    n_heads = module.n_heads
+    b, s, d = x.shape
+    dh = d // n_heads
+    h = _ln(bp["LayerNorm_0"], x, dtype)
+    qkv = _dense(bp["qkv"], h, dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, 1, n_heads, dh)
+    q, k, v = (t.reshape(shape) for t in (q, k, v))
+    w_l = cache[0].shape[1]
+    owns = (slot >= lo) & (slot < lo + w_l)
+    local_slot = jnp.clip(slot - lo, 0, w_l - 1)
+    if cache_kind == "int8":
+        from mmlspark_tpu.quant.quantize import quantize_kv
+        kq, ks, vq, vs = cache
+        k8, k8s = quantize_kv(k)
+        v8, v8s = quantize_kv(v)
+        kq = jnp.where(owns, lax.dynamic_update_slice(
+            kq, k8, (0, local_slot, 0, 0)), kq)
+        ks = jnp.where(owns, lax.dynamic_update_slice(
+            ks, k8s, (0, local_slot, 0)), ks)
+        vq = jnp.where(owns, lax.dynamic_update_slice(
+            vq, v8, (0, local_slot, 0, 0)), vq)
+        vs = jnp.where(owns, lax.dynamic_update_slice(
+            vs, v8s, (0, local_slot, 0)), vs)
+        acc, m, l = single_query_attention_stats(q[:, 0], kq, vq, visible,
+                                                 k_scale=ks, v_scale=vs)
+        cache = (kq, ks, vq, vs)
+    else:
+        k_cache, v_cache = cache
+        k_cache = jnp.where(owns, lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, local_slot, 0, 0)),
+            k_cache)
+        v_cache = jnp.where(owns, lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, local_slot, 0, 0)),
+            v_cache)
+        acc, m, l = single_query_attention_stats(q[:, 0], k_cache, v_cache,
+                                                 visible)
+        cache = (k_cache, v_cache)
+    o = merge_attention_stats(acc, m, l, axis_name=seq_axis)
+    x = x + _dense(bp["proj"], o.reshape(b, 1, d).astype(dtype), dtype)
+    h2 = _ln(bp["LayerNorm_1"], x, dtype)
+    return x + _mlp(module, bp, h2, dtype), cache
+
+
+def _seq_decode_step(params: dict, tok: jax.Array, pos: jax.Array, slot,
+                     lo, caches: list, visible, module,
+                     cache_kind: str, seq_axis: str):
+    """`_decode_step` inside the seq shard_map region: same per-row
+    positions / shared global write `slot`, but `visible` covers only
+    the local window slab and each block merges softmax stats across
+    `seq`.  The non-attention compute (embeddings, MLPs, head) is
+    replicated per seq shard — deterministic-identical on every chip, so
+    the logits really are replicated over `seq` as the out_specs
+    claim."""
+    dtype = module.dtype
+    emb = (params["tok_embed"]["embedding"][tok]
+           + params["pos_embed"]["embedding"][pos])
+    x = emb[:, None].astype(dtype)
+    new_caches = []
+    for i in range(module.n_layers):
+        x, cache = _seq_decode_block(module, params[f"block{i}_w"], x,
+                                     caches[i], slot, lo, visible, dtype,
+                                     cache_kind, seq_axis)
+        new_caches.append(cache)
+    x = _ln(params["final_norm_w"], x, dtype)
+    logits = _dense(params["lm_head"], x, dtype).astype(jnp.float32)
+    return logits[:, 0], new_caches
+
+
 def _row_write(cache: jax.Array, update: jax.Array,
                slots: jax.Array) -> jax.Array:
     """Write a contiguous block of new entries per row at a PER-ROW
@@ -866,7 +999,10 @@ def serialize_cache_row(caches, row: int, chunk: int) -> list:
     and the 4-tuple int8 (kq, k_scale, vq, v_scale); int8 pages
     naturally shrink the wire bytes, which is the point of quantizing
     BEFORE shipping.  The explicit dtype name (not npy) is what keeps
-    bfloat16 byte-exact across the wire."""
+    bfloat16 byte-exact across the wire.  Seq-sharded caches gather here
+    IMPLICITLY: `np.asarray` on a sharded row pulls the full window to
+    host — fine on single-process (fully-addressable) meshes, which is
+    the only place this serializer runs."""
     import io
     page_hdr, tens_hdr, u32 = _page_structs()
     host = [[np.asarray(t[row]) for t in layer] for layer in caches]
@@ -1025,6 +1161,44 @@ class DecodeEngine:
                 raise ValueError(
                     f"stop token {t} outside the vocabulary "
                     f"(0..{module.vocab_size - 1})")
+        seq_shards = (int(mesh.shape.get(SEQ_AXIS, 1))
+                      if mesh is not None else 1)
+        if seq_shards > 1:
+            # the seq-sharded engine path: long-context decode with the
+            # KV window partitioned over 'seq'.  Its refusals bound the
+            # composition space — everything below is a real algorithmic
+            # conflict, not a not-yet
+            if int(mesh.shape.get(MODEL_AXIS, 1)) > 1:
+                raise ValueError(
+                    "seq-sharded decode (mesh seq>1) does not compose "
+                    "with model>1: the seq path keeps heads unsharded "
+                    "(SEQ_KV_CACHE_SPEC) so the stats merge is the only "
+                    "cross-chip attention collective")
+            if module.mlp_impl == "moe":
+                raise ValueError(
+                    "seq-sharded decode does not support MoE models: "
+                    "per-shard expert routing would diverge from the "
+                    "global capacity groups (see _mlp)")
+            if draft_module is not None:
+                raise ValueError(
+                    "seq-sharded decode does not compose with "
+                    "speculative decoding: the multi-token verify "
+                    "forward has no seq-sharded cache path")
+            if prefill_chunk is not None:
+                raise ValueError(
+                    "seq-sharded decode does not compose with chunked "
+                    "prefill: distributed blockwise (ring) prefill "
+                    "already splits the prompt over chips")
+            if chunk % seq_shards:
+                raise ValueError(
+                    f"cache chunk ({chunk}) must divide by the mesh seq "
+                    f"axis ({seq_shards}) so every window width shards "
+                    "evenly")
+            if min_bucket % seq_shards:
+                raise ValueError(
+                    f"min_bucket ({min_bucket}) must divide by the mesh "
+                    f"seq axis ({seq_shards}) so every prompt bucket "
+                    "shards evenly")
         self.module = module
         self.max_new_tokens = max_new_tokens
         self.stop_tokens = stop_tokens
@@ -1039,6 +1213,8 @@ class DecodeEngine:
         # segments, merge) traces under use_mesh(mesh), so at mp >= 2 the
         # cache keeps heads on 'model' end to end; None = single-device
         self.mesh = mesh
+        # window shards over 'seq' (1 = the classic whole-window engine)
+        self.seq_shards = seq_shards
         # the fused Pallas single-query kernel only runs single-device:
         # pallas_call has no SPMD partitioning rule, so under a mesh the
         # decode step keeps the einsum composition GSPMD can shard.  (The
@@ -1111,6 +1287,124 @@ class DecodeEngine:
             (tok, done, caches), toks = lax.scan(
                 step, (tok, done, caches), jnp.arange(seg_len))
             return caches, toks.transpose(1, 0), tok, done
+
+        if seq_shards > 1:
+            # SEQ-SHARDED engine: replace the prefill/segment impls with
+            # shard_map'd equivalents before the meshed wrappers below
+            # close over the names.  Prefill runs DISTRIBUTED BLOCKWISE
+            # (ring attention over the prompt slabs — wall clock ~1/n);
+            # decode keeps the host segment loop identical but merges
+            # per-chip softmax stats across 'seq' each step.
+            from jax.sharding import PartitionSpec as P
+            from mmlspark_tpu.parallel.ring import _shard_map
+            tok_spec = P(DATA_AXIS, SEQ_AXIS)
+            row_spec = P(DATA_AXIS)
+
+            def _seq_cache_specs(caches):
+                return [tuple(SEQ_KV_CACHE_SPEC if c.ndim == 4
+                              else SEQ_KV_SCALE_SPEC for c in layer)
+                        for layer in caches]
+
+            def seq_prefill_impl(variables, prompts, true_len, live,
+                                 row_keys):
+                params = variables["params"]
+                p = prompts.shape[1]
+                w0 = _round_up(p + 1, chunk)
+                dtype = module.dtype
+
+                def local_fwd(params, tokens):
+                    s_l = tokens.shape[1]
+                    lo = lax.axis_index(SEQ_AXIS) * s_l
+                    # SHARED positions 0..p-1 (the global slab offset),
+                    # exactly _forward_with_cache's position stream —
+                    # causal masking alone makes the per-row true_len-1
+                    # logit gather correct
+                    positions = lo + jnp.arange(s_l)
+                    emb = (params["tok_embed"]["embedding"][tokens]
+                           + params["pos_embed"]["embedding"][positions][
+                               None])
+                    x = emb.astype(dtype)
+                    kvs = []
+                    for i in range(module.n_layers):
+                        x, k_l, v_l = _seq_prefill_block(
+                            module, params[f"block{i}_w"], x, dtype,
+                            SEQ_AXIS)
+                        kvs.append((k_l.astype(dtype), v_l.astype(dtype)))
+                    x = _ln(params["final_norm_w"], x, dtype)
+                    logits = _dense(params["lm_head"], x,
+                                    dtype).astype(jnp.float32)
+                    return logits, kvs
+
+                logits, kvs = _shard_map(
+                    local_fwd, mesh=mesh,
+                    in_specs=(P(), tok_spec),
+                    out_specs=(P(DATA_AXIS, SEQ_AXIS, None),
+                               SEQ_KV_CACHE_SPEC))(params, prompts)
+                last = jnp.take_along_axis(
+                    logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
+                tok = sample(last, row_keys, 0)
+                done = ~live | stop_gate(tok, 1)
+                # the cache window (w0, chunk-aligned) has DIFFERENT seq
+                # partition boundaries than the prompt (p): pad outside
+                # the shard_map and let GSPMD reshard once against the
+                # hint — not inside, where slab widths would disagree
+                caches = [(_hint_seq_kv(_grow_cache(k_l, w0)),
+                           _hint_seq_kv(_grow_cache(v_l, w0)))
+                          for k_l, v_l in kvs]
+                if cache_dtype == "int8":
+                    caches = [tuple(_hint_seq_kv(c)
+                                    for c in _quantize_cache(kc, vc))
+                              for kc, vc in caches]
+                return tok, done, caches
+
+            def seq_segment_impl(seg_len, window, variables, caches, tok,
+                                 done, true_len, bucket, t0, row_keys):
+                params = variables["params"]
+                caches = [tuple(_hint_seq_kv(_grow_cache(c, window))
+                                for c in layer) for layer in caches]
+                cache_specs = _seq_cache_specs(caches)
+                # typed PRNG keys are an extended dtype shard_map can't
+                # always carry (jax 0.4.x): thread the raw uint32 key
+                # data through and rebuild inside
+                rk = jax.random.key_data(row_keys)
+
+                def local_seg(params, caches, tok, done, true_len, bucket,
+                              t0, rk):
+                    row_keys = jax.random.wrap_key_data(rk)
+                    w_l = caches[0][0].shape[1]
+                    lo = lax.axis_index(SEQ_AXIS) * w_l
+                    slots = lo + jnp.arange(w_l)
+
+                    def step(carry, s_off):
+                        tok, done, caches = carry
+                        t = t0 + s_off
+                        slot = bucket + t
+                        pos = true_len + t
+                        visible = ((slots[None, :] < true_len[:, None])
+                                   | ((slots[None, :] >= bucket)
+                                      & (slots[None, :] <= slot)))
+                        logits, caches = _seq_decode_step(
+                            params, tok, pos, slot, lo, caches, visible,
+                            module, cache_dtype, SEQ_AXIS)
+                        nxt = sample(logits, row_keys, t + 1)
+                        nxt = jnp.where(done, tok, nxt)
+                        return (nxt, done | stop_gate(nxt, t + 2),
+                                caches), tok
+
+                    (tok, done, caches), toks = lax.scan(
+                        step, (tok, done, caches), jnp.arange(seg_len))
+                    return caches, toks.transpose(1, 0), tok, done
+
+                return _shard_map(
+                    local_seg, mesh=mesh,
+                    in_specs=(P(), cache_specs, row_spec, row_spec,
+                              row_spec, P(), P(), P(DATA_AXIS, None)),
+                    out_specs=(cache_specs, P(DATA_AXIS, None), row_spec,
+                               row_spec))(
+                    params, caches, tok, done, true_len, bucket, t0, rk)
+
+            prefill_impl = seq_prefill_impl
+            segment_impl = seq_segment_impl
 
         row_sample = _make_row_sampler(temperature,
                                        None if greedy else top_k,
@@ -1497,12 +1791,26 @@ class DecodeEngine:
     # keep the jit shape-class discipline (and the recompile telemetry)
     # of the batch path.
 
+    def _refuse_seq(self, hook: str) -> None:
+        """Serving hooks refuse a seq-sharded engine: continuous
+        batching's per-row cache writes, row splices, and prefix-cache
+        handoff pages all assume whole-window rows on one device.  Use
+        `generate()` / TextGenerator for seq-parallel long-context
+        decode."""
+        if self.seq_shards > 1:
+            raise ValueError(
+                f"{hook} does not support a seq-sharded engine (mesh "
+                f"seq={self.seq_shards}): serving assumes whole-window "
+                "cache rows; use DecodeEngine.generate / TextGenerator "
+                "for seq-parallel long-context decode")
+
     def serve_prefill(self, variables, prompts, true_len, live, row_keys):
         """Prefill one join cohort: prompts (N, bucket) right-padded,
         per-row true lengths, `live=False` born-done pad rows, per-row
         sampling keys.  Returns (tok, done, caches) — the cohort's first
         generated token per row and its bucket-window caches, ready to
         splice into a resident batch with `merge_cache_rows`."""
+        self._refuse_seq("serve_prefill")
         b, p = prompts.shape
         key = ("prefill", b, p)
         tok, done, caches = self._prefill(
@@ -1520,6 +1828,7 @@ class DecodeEngine:
         (B, seg_len), tok, done).  `window` must cover the highest slot
         any live row writes: bucket + max(t_row) + seg_len, chunk-rounded
         (`serve_window`)."""
+        self._refuse_seq("serve_step")
         b = int(tok.shape[0])
         w_in = int(caches[0][0].shape[1])
         # a resident cache never shrinks: joins after long-running rows
@@ -1560,6 +1869,7 @@ class DecodeEngine:
         previous chunk returned.  The serving engine interleaves these
         calls with resident decode segments, so a long prompt never
         stalls running requests (serve/engine.py)."""
+        self._refuse_seq("serve_prefill_chunk")
         prompts = np.asarray(prompts)
         b, p = prompts.shape
         cl = self.prefill_chunk
@@ -1580,6 +1890,7 @@ class DecodeEngine:
     def serve_prefill_finish(self, state, live, row_keys):
         """Close a chunked serve prefill: the same (tok, done, caches)
         contract as `serve_prefill`, ready for `merge_cache_rows`."""
+        self._refuse_seq("serve_prefill_finish")
         caches, last = state
         b = int(last.shape[0])
         w0 = int(caches[0][0].shape[1])
@@ -1607,6 +1918,7 @@ class DecodeEngine:
         prefix): dequantize/grow to the bucket window and zero the
         running logits — a (caches, last) state `serve_prefill_chunk`
         (index >= 1) and `serve_prefill_finish` continue verbatim."""
+        self._refuse_seq("serve_resume_init")
         w0 = _round_up(bucket + 1, self.chunk)
         b = int(row_caches[0][0].shape[0])
         n = int(row_caches[0][0].shape[1])
@@ -1625,6 +1937,7 @@ class DecodeEngine:
         greedy outputs are the contract (model-dtype rows exact; int8
         rows carry the documented quantization caveat).  Same
         (tok, done, caches) contract as `serve_prefill`."""
+        self._refuse_seq("serve_prefill_resume")
         prompts = np.asarray(prompts)
         b, p = prompts.shape
         if not 0 < prefix_len < p:
@@ -1644,6 +1957,7 @@ class DecodeEngine:
         """Prefill the draft model's cache for a join cohort (speculative
         serving): returns the draft caches to splice alongside the target
         caches (`merge_cache_rows` handles both)."""
+        self._refuse_seq("serve_draft_prefill")
         prompts = np.asarray(prompts)
         b, p = prompts.shape
         caches = self._draft_prefill(draft_variables,
@@ -1660,6 +1974,7 @@ class DecodeEngine:
         budgets from the start).  Returns (caches, draft_caches, toks
         (B, k+1), counts, tok, done, accepted); the engine advances each
         row's t_row by its count and emits the counted prefix."""
+        self._refuse_seq("serve_spec_round")
         b = int(tok.shape[0])
         w_in = int(caches[0][0].shape[1])
         window = max(int(window), w_in,
@@ -1689,6 +2004,13 @@ class DecodeEngine:
         cascade of eager ops.  Pass `mesh` (an engine's `.mesh`) so the
         merge program's KV hints trace against it — sharded resident
         caches then stay sharded through every join."""
+        if mesh is not None and int(mesh.shape.get(SEQ_AXIS, 1)) > 1:
+            raise ValueError(
+                "merge_cache_rows refuses seq-sharded caches (mesh "
+                "seq>1): row splicing assumes whole-window rows; gather "
+                "a row explicitly (serialize_cache_row np.asarray-"
+                "gathers the window) or decode outside the serving join "
+                "path")
         di = jnp.asarray(dst_rows, jnp.int32)
         si = jnp.asarray(src_rows, jnp.int32)
         with use_mesh(mesh):
@@ -1772,6 +2094,11 @@ class DecodeEngine:
                 f"prompt_len ({int(tl_host.max())}) + max_new_tokens "
                 f"({self.max_new_tokens}) exceeds the model's max_len "
                 f"({self.module.max_len})")
+        if p % self.seq_shards:
+            raise ValueError(
+                f"prompt bucket ({p}) must divide by the mesh seq axis "
+                f"({self.seq_shards}) for distributed blockwise prefill "
+                "(pad the bucket — true_len already handles the tail)")
         base = rng if rng is not None else jax.random.key(0)
         ids = jnp.arange(b) if row_ids is None else jnp.asarray(row_ids)
         row_keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(ids)

@@ -103,6 +103,72 @@ def single_query_attention(q: jax.Array, k_cache: jax.Array,
     return jnp.einsum("bhl,blhd->bhd", w, v_cache.astype(jnp.float32))
 
 
+def single_query_attention_stats(q: jax.Array, k_cache: jax.Array,
+                                 v_cache: jax.Array, visible: jax.Array,
+                                 scale: Optional[float] = None,
+                                 k_scale: Optional[jax.Array] = None,
+                                 v_scale: Optional[jax.Array] = None
+                                 ) -> tuple:
+    """`single_query_attention` stopped before the normalize: the online-
+    softmax partial statistics of one cache SHARD, ready for a cross-shard
+    merge (`merge_attention_stats`) — the seq-sharded decode read.
+
+    Same contract as the reference for q/caches/visible/scales, but the
+    window L here is one device's LOCAL slice of the cache (the `visible`
+    mask is computed against global slot ids by the caller, so ownership
+    is pure layout).  Returns float32 (acc (B, H, D), m (B, H), l (B, H)):
+    `acc` is the exp-weighted V sum against the LOCAL max `m`, `l` the
+    local normalizer.  A shard whose every slot is masked reports
+    m = NEG_INF, l = 0, acc = 0 — the merge's correction weight zeroes it
+    out exactly, so ragged occupancy across shards never skews the
+    softmax.  int8 dequant scales compose unchanged: k_scale multiplies
+    the score row AFTER QK^T and v_scale folds into the weights BEFORE
+    the PV einsum, both strictly local operations.
+
+    On one shard `merge_attention_stats(acc, m, l)` reduces to acc / l —
+    the same statistics `single_query_attention`'s softmax computes, so
+    the two paths agree to float32 rounding (test-pinned)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    if k_scale is not None:
+        s = s * k_scale.astype(jnp.float32).transpose(0, 2, 1)
+    s = jnp.where(visible[:, None, :], s, NEG_INF)
+    m = s.max(axis=-1)                                       # (B, H)
+    safe_m = jnp.where(m == NEG_INF, 0.0, m)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(s == NEG_INF, 0.0, p)
+    l = p.sum(axis=-1)
+    if v_scale is not None:
+        p = p * v_scale.astype(jnp.float32).transpose(0, 2, 1)
+    acc = jnp.einsum("bhl,blhd->bhd", p, v_cache.astype(jnp.float32))
+    return acc, m, l
+
+
+def merge_attention_stats(acc: jax.Array, m: jax.Array, l: jax.Array,
+                          axis_name: Optional[str] = None) -> jax.Array:
+    """The collective epilogue of a seq-sharded decode step: rescale each
+    shard's partial (acc, m, l) statistics to the GLOBAL running max and
+    reduce — one pmax plus one psum-pair of (B, H)-sized exchanges, the
+    only cross-chip traffic the sharded cache read costs.
+
+    With `axis_name=None` (single shard, tests) the same algebra runs
+    without collectives: out = acc / l with the zero-row guard.  The
+    rescale is exactly the flash/ring fold's correction term, so merging
+    N shards computes the same softmax the one-shard read would — a
+    fully-masked shard (m = NEG_INF) contributes weight 0.  Returns
+    (B, H, D) float32, the `single_query_attention` output contract."""
+    if axis_name is not None:
+        m_g = jax.lax.pmax(m, axis_name)
+        safe_m = jnp.where(m_g == NEG_INF, 0.0, m_g)
+        corr = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - safe_m))
+        l = jax.lax.psum(l * corr, axis_name)
+        acc = jax.lax.psum(acc * corr[..., None], axis_name)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return acc / l_safe[..., None]
+
+
 def segment_cache_attention(q: jax.Array, k_cache: jax.Array,
                             v_cache: jax.Array, visible: jax.Array,
                             scale: Optional[float] = None,

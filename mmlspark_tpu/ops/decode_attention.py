@@ -44,7 +44,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from mmlspark_tpu.ops.attention import NEG_INF, single_query_attention
+from mmlspark_tpu.ops.attention import (NEG_INF, single_query_attention,
+                                        single_query_attention_stats)
 from mmlspark_tpu.ops.flash_attention import (_auto_interpret,
                                               _in_manual_region)
 
@@ -94,16 +95,22 @@ def _scale_pad(n_heads: int):
     return (rows == cols).astype(jnp.float32)
 
 
-def _sqa_kernel(q_ref, k_ref, v_ref, vis_ref, ks_ref, vs_ref, o_ref,
+def _sqa_kernel(q_ref, k_ref, v_ref, vis_ref, ks_ref, vs_ref, out_refs,
                 acc_ref, m_ref, l_ref, *, scale: float, n_heads: int,
-                head_dim: int, block_k: int):
+                head_dim: int, block_k: int, emit_stats: bool = False):
     """One (batch row, k-block) grid step.
 
     The grid's inner dimension walks the window's K/V blocks; the
     online-softmax state (acc, running max m, normalizer l) persists in
     VMEM scratch across those steps (TPU grids execute minor-to-major on
     one core), so VMEM holds one K/V block at a time and the window is
-    bounded by HBM, not VMEM."""
+    bounded by HBM, not VMEM.
+
+    `out_refs` is `(o_ref,)` for the normalized read, or — with
+    `emit_stats` — `(acc_out, m_out, l_out)`: the final block then writes
+    the raw online-softmax statistics instead of dividing, for the
+    seq-sharded decode's cross-chip merge
+    (`ops/attention.merge_attention_stats`)."""
     j = pl.program_id(1)
     nk = pl.num_programs(1)
 
@@ -163,6 +170,13 @@ def _sqa_kernel(q_ref, k_ref, v_ref, vis_ref, ks_ref, vs_ref, o_ref,
 
     @pl.when(j == nk - 1)
     def _():
+        if emit_stats:
+            oa_ref, om_ref, ol_ref = out_refs
+            oa_ref[0] = acc_ref[:][0:1].astype(oa_ref.dtype)
+            om_ref[0] = m_ref[:][0:1].astype(om_ref.dtype)
+            ol_ref[0] = l_ref[:][0:1].astype(ol_ref.dtype)
+            return
+        (o_ref,) = out_refs
         l_fin = l_ref[:][0:1]
         l_safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
         l_exp = jax.lax.dot_general(l_safe, _head_selector(n_heads,
@@ -176,7 +190,8 @@ def _sqa_kernel(q_ref, k_ref, v_ref, vis_ref, ks_ref, vs_ref, o_ref,
 
 
 def _fused_forward(q, k_cache, v_cache, visible, scale, k_scale, v_scale,
-                   block_k: int, interpret: bool):
+                   block_k: int, interpret: bool,
+                   emit_stats: bool = False):
     b, h, d = q.shape
     l = k_cache.shape[1]
     hd = h * d
@@ -199,21 +214,40 @@ def _fused_forward(q, k_cache, v_cache, visible, scale, k_scale, v_scale,
                      pl.BlockSpec((1, block_k, h), lambda i, j: (i, j, 0))]
         args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
 
+    n_out = 3 if emit_stats else 1
+
     def kernel(q_ref, k_ref, v_ref, vis_ref, *rest):
         if quantized:
-            ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+            ks_ref, vs_ref, rest = rest[0], rest[1], rest[2:]
         else:
-            (o_ref, acc_ref, m_ref, l_ref), ks_ref, vs_ref = rest, None, None
-        _sqa_kernel(q_ref, k_ref, v_ref, vis_ref, ks_ref, vs_ref, o_ref,
+            ks_ref, vs_ref = None, None
+        out_refs, (acc_ref, m_ref, l_ref) = rest[:n_out], rest[n_out:]
+        _sqa_kernel(q_ref, k_ref, v_ref, vis_ref, ks_ref, vs_ref, out_refs,
                     acc_ref, m_ref, l_ref, scale=scale, n_heads=h,
-                    head_dim=d, block_k=block_k)
+                    head_dim=d, block_k=block_k, emit_stats=emit_stats)
+
+    if emit_stats:
+        # raw statistics: acc on the folded lanes, m/l one lane per head
+        out_specs = [pl.BlockSpec((1, 1, hd), lambda i, j: (i, 0, 0)),
+                     pl.BlockSpec((1, 1, _STATS_LANES),
+                                  lambda i, j: (i, 0, 0)),
+                     pl.BlockSpec((1, 1, _STATS_LANES),
+                                  lambda i, j: (i, 0, 0))]
+        out_shape = [jax.ShapeDtypeStruct((b, 1, hd), jnp.float32),
+                     jax.ShapeDtypeStruct((b, 1, _STATS_LANES),
+                                          jnp.float32),
+                     jax.ShapeDtypeStruct((b, 1, _STATS_LANES),
+                                          jnp.float32)]
+    else:
+        out_specs = pl.BlockSpec((1, 1, hd), lambda i, j: (i, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((b, 1, hd), jnp.float32)
 
     out = pl.pallas_call(
         kernel,
         grid=(b, l // block_k),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, hd), lambda i, j: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, 1, hd), jnp.float32),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((8, hd), jnp.float32),            # acc (folded lanes)
             pltpu.VMEM((8, _STATS_LANES), jnp.float32),  # running max / head
@@ -221,6 +255,9 @@ def _fused_forward(q, k_cache, v_cache, visible, scale, k_scale, v_scale,
         ],
         interpret=interpret,
     )(*args)
+    if emit_stats:
+        acc, m, lsum = out
+        return (acc.reshape(b, h, d), m[:, 0, :h], lsum[:, 0, :h])
     return out.reshape(b, h, d)
 
 
@@ -286,4 +323,59 @@ def fused_single_query_attention(q: jax.Array, k_cache: jax.Array,
                           v_scale, block_k, interpret)
 
 
-__all__ = ["fused_single_query_attention"]
+def fused_single_query_attention_stats(q: jax.Array, k_cache: jax.Array,
+                                       v_cache: jax.Array,
+                                       visible: jax.Array,
+                                       scale: Optional[float] = None,
+                                       k_scale: Optional[jax.Array] = None,
+                                       v_scale: Optional[jax.Array] = None,
+                                       *, block_k: int = 256,
+                                       interpret: Optional[bool] = None):
+    """`single_query_attention_stats` with the fused cache read on TPU.
+
+    Identical streaming to `fused_single_query_attention`, but the final
+    block writes the raw online-softmax statistics instead of normalizing:
+    returns float32 `(acc (B, H, D), m (B, H), l (B, H))` — the local-shard
+    triple `ops/attention.merge_attention_stats` combines across a
+    seq-sharded KV cache (running max via pmax, rescaled normalizer and
+    accumulator via psum).  A fully-masked row reports m == NEG_INF and
+    l == 0, the merge identity.  Fallback ladder matches the normalized
+    wrapper exactly, landing on the XLA-composed reference stats.
+    """
+    b, h, d = q.shape
+    l = k_cache.shape[1]
+    scale_ = scale if scale is not None else d ** -0.5
+    block_k = min(block_k, l)
+    if interpret is None:
+        if _auto_interpret():
+            return single_query_attention_stats(q, k_cache, v_cache,
+                                                visible, scale_, k_scale,
+                                                v_scale)
+        interpret = False
+
+    reason = None
+    if _in_manual_region(q):
+        reason = "shard_map manual region (the partitioner owns placement)"
+    elif (k_scale is None) != (v_scale is None):
+        reason = "mixed quantization (k_scale xor v_scale)"
+    elif h > _STATS_LANES:
+        reason = f"n_heads {h} exceeds the {_STATS_LANES}-lane stats tile"
+    elif l % block_k:
+        reason = (f"window {l} does not tile block_k {block_k} (round the "
+                  "window to a block multiple or shrink block_k)")
+    elif not interpret:
+        sub = {jnp.int8.dtype: 32, jnp.bfloat16.dtype: 16}.get(
+            k_cache.dtype, 8)
+        if block_k % sub:
+            reason = (f"block_k {block_k} is not a multiple of the "
+                      f"{k_cache.dtype} sublane tile ({sub})")
+    if reason is not None:
+        _warn_reference_fallback(reason, b, l, block_k, interpret)
+        return single_query_attention_stats(q, k_cache, v_cache, visible,
+                                            scale_, k_scale, v_scale)
+    return _fused_forward(q, k_cache, v_cache, visible, scale_, k_scale,
+                          v_scale, block_k, interpret, emit_stats=True)
+
+
+__all__ = ["fused_single_query_attention",
+           "fused_single_query_attention_stats"]

@@ -42,6 +42,15 @@ MESH_MODEL = config.register(
         "shard over this many chips. 1 (default) keeps every path "
         "data-parallel-only.")
 
+MESH_SEQ = config.register(
+    "MMLSPARK_TPU_MESH_SEQ", default=1, ptype=int,
+    doc="Sequence-parallel mesh width for the default mesh: long-context "
+        "decode shards the KV-cache window over this many chips "
+        "(blockwise ring prefill + cross-chip softmax-stats merge, "
+        "models/generate.py). Composes with MESH_DATA; mutually "
+        "exclusive with MESH_MODEL>1 on the decode path. 1 (default) "
+        "keeps the single-chip window.")
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
@@ -87,7 +96,8 @@ def make_mesh(spec: Optional[MeshSpec] = None,
 def mesh_spec_from_config() -> MeshSpec:
     """The MeshSpec the MMLSPARK_TPU_MESH_* knobs declare (dp x mp)."""
     return MeshSpec(data=int(MESH_DATA.current()),
-                    model=int(MESH_MODEL.current()))
+                    model=int(MESH_MODEL.current()),
+                    seq=int(MESH_SEQ.current()))
 
 
 def default_mesh() -> Mesh:
@@ -101,7 +111,7 @@ def default_mesh() -> Mesh:
     rules (parallel/partition.py), batches stay on the data axis.
     """
     spec = mesh_spec_from_config()
-    if spec.model <= 1 and spec.data <= 0:
+    if spec.model <= 1 and spec.seq <= 1 and spec.data <= 0:
         return best_mesh()
     local = jax.local_devices() if jax.process_count() > 1 else jax.devices()
     if spec.data <= 0:
@@ -112,7 +122,7 @@ def default_mesh() -> Mesh:
     n = sizes["data"] * sizes["model"] * sizes["seq"]
     if n > len(local):
         raise ValueError(
-            f"MMLSPARK_TPU_MESH_DATA x MODEL wants {n} devices, "
+            f"MMLSPARK_TPU_MESH_DATA x MODEL x SEQ wants {n} devices, "
             f"have {len(local)}")
     return make_mesh(MeshSpec(**sizes), local[:n])
 

@@ -42,7 +42,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 # -- rule sets ---------------------------------------------------------------
 
@@ -86,6 +86,15 @@ KV_SCALE_SPEC = P(DATA_AXIS, None, MODEL_AXIS)
 # above) free to shard.  Draft params replicate for the same reason.
 DRAFT_KV_CACHE_SPEC = P(DATA_AXIS, None, None, None)
 DRAFT_KV_SCALE_SPEC = P(DATA_AXIS, None, None)
+
+# Sequence-sharded decode (models/generate.py seq path): the KV cache's
+# WINDOW axis splits over "seq" — each chip owns a contiguous slab of
+# cache slots, the decode step merges per-shard softmax statistics
+# (ops/attention.merge_attention_stats) instead of gathering the window.
+# Heads stay unsharded: the seq engine path refuses model>1 meshes, so
+# naming MODEL_AXIS here would only demote on the meshes that reach it.
+SEQ_KV_CACHE_SPEC = P(DATA_AXIS, SEQ_AXIS, None, None)
+SEQ_KV_SCALE_SPEC = P(DATA_AXIS, SEQ_AXIS, None)
 
 
 def path_str(path: Sequence) -> str:

@@ -21,15 +21,37 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from mmlspark_tpu.ops.attention import attention, ring_attention, ulysses_attention
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+from mmlspark_tpu.parallel.partition import named_sharding
 
 try:  # jax >= 0.8 top-level API; the experimental path is deprecated
-    from jax import shard_map as _shard_map
+    from jax import shard_map as _shard_map_raw
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """`shard_map` with replication checking off — the repo-wide seam.
+
+    The replication checker has no rule for `checkpoint_name` (the remat
+    tag the seq-parallel LM forward emits) or `pallas_call` (the flash
+    kernel ring_flash rotates) on the pinned jax build, so every sharded
+    region here runs unchecked: out_specs state the replication facts the
+    checker would otherwise verify.  The kwarg spelling moved across jax
+    versions (`check_rep` -> `check_vma`), so probe newest-first and fall
+    through to a bare call on builds that dropped the knob entirely.
+    """
+    for kwarg in ("check_vma", "check_rep"):
+        try:
+            return _shard_map_raw(fn, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, **{kwarg: False})
+        except TypeError:
+            continue
+    return _shard_map_raw(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs)
 
 
 def seq_parallel_attention(mesh: Mesh, q, k, v, causal: bool = False,
@@ -71,7 +93,8 @@ def seq_parallel_attention(mesh: Mesh, q, k, v, causal: bool = False,
 def make_seq_parallel_lm_step(module, tx: optax.GradientTransformation,
                               mesh: Mesh,
                               data_axis: str = DATA_AXIS,
-                              seq_axis: str = SEQ_AXIS) -> Callable:
+                              seq_axis: str = SEQ_AXIS,
+                              remat: bool = False) -> Callable:
     """Build a jitted LM train step with batch over `data` and sequence
     over `seq`.
 
@@ -81,7 +104,17 @@ def make_seq_parallel_lm_step(module, tx: optax.GradientTransformation,
     with psum over both axes, and jax.grad differentiates straight through
     the collectives (ppermute/psum have registered transposes).  Params
     and optimizer state stay replicated.
+
+    `remat=True` turns on block-boundary activation rematerialization
+    (the module's own `remat` field — each TransformerBlock recomputes its
+    activations in the backward): inside the ring loop that is the 32k+
+    story, since the per-fold score blocks are what blow HBM at long
+    S_local.  The `checkpoint_name` tags this emits inside the sharded
+    region are exactly why `_shard_map` runs with replication checking
+    off.
     """
+    if remat and getattr(module, "remat", None) is False:
+        module = module.clone(remat=True)
 
     def local_loss(params, tokens, targets, mask):
         logits = module.apply(params, tokens)          # (b_l, s_l, V)
@@ -112,6 +145,9 @@ def make_seq_parallel_lm_step(module, tx: optax.GradientTransformation,
 def shard_tokens(tokens: np.ndarray, mesh: Mesh,
                  data_axis: str = DATA_AXIS,
                  seq_axis: str = SEQ_AXIS) -> jax.Array:
-    """Place (B, S) token arrays with B over data, S over seq."""
+    """Place (B, S) token arrays with B over data, S over seq.
+
+    Placement routes through `parallel/partition.named_sharding` — the
+    one sanctioned NamedSharding construction seam (scripts/lint.py)."""
     return jax.device_put(
-        tokens, NamedSharding(mesh, P(data_axis, seq_axis)))
+        tokens, named_sharding(mesh, P(data_axis, seq_axis)))

@@ -324,6 +324,13 @@ class ServingEngine:
         # serving over a device mesh: weights are placed once (replicated
         # at mp=1, partition-rule sharded when the mesh has a model axis)
         # and every DecodeEngine program traces its KV hints against it
+        if mesh is not None and int(mesh.shape.get("seq", 1)) > 1:
+            raise ValueError(
+                "ServingEngine does not support a seq-sharded mesh "
+                "(seq>1): continuous batching splices and pages "
+                "whole-window cache rows, which a seq-partitioned "
+                "window breaks up; use DecodeEngine.generate / "
+                "TextGenerator for seq-parallel long-context decode")
         self._mesh = mesh
         # speculative lanes: one shared draft (zoo/speculative.py) drafts
         # for every lane — greedy exactness is per-lane by construction,

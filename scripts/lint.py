@@ -98,6 +98,9 @@ HOT_LOOP_FILES = {
     # the fused decode kernel runs once per generated token inside the
     # compiled serve/decode programs — the hottest read in the stack
     os.path.join("mmlspark_tpu", "ops", "decode_attention.py"),
+    # the prefill flash kernel runs inside every long-prompt prefill and
+    # every ring-prefill rotation step (seq-sharded decode engines)
+    os.path.join("mmlspark_tpu", "ops", "flash_attention.py"),
 }
 
 # whole directories on the hot path: every quant/ module runs inside the

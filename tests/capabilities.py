@@ -2,13 +2,17 @@
 `requires_env` marker).
 
 A handful of tier-1 tests exercise constructs this image's jax build (or
-its process environment) cannot run: multiprocess CPU collectives,
-shard_map replication rules for `pallas_call`/`checkpoint_name`, the
+its process environment) cannot run: multiprocess CPU collectives, the
 `jax.lax.pcast` varying-cast, and the pip-installed package.  Before this
 fixture they ERRORED at setup — a known-broken wall of tracebacks that
 buried real regressions.  Each probe here answers "can this environment
 run the construct at all" once per session (lru_cache), so the tests SKIP
 with an explicit, actionable reason instead.
+
+(The former `shard_map_checkpoint_name` / `shard_map_pallas` probes are
+retired: parallel/ring.py's `_shard_map` compat wrapper now degrades to
+`check_rep=False` on builds without those replication rules, so the
+seq-parallel tests run everywhere instead of skipping.)
 
 Probes are deliberately minimal — the smallest program that trips the
 same missing capability the real test would, never the workload itself —
@@ -54,77 +58,6 @@ def _probe_lax_pcast():
                 "pipeline-parallel scan carry needs the varying cast)")
     return None
 
-
-def _two_device_mesh():
-    import jax
-    import numpy as np
-    devs = jax.devices("cpu")[:2]
-    if len(devs) < 2:
-        return None
-    return jax.sharding.Mesh(np.array(devs), ("x",))
-
-
-def _probe_shard_map_checkpoint_name():
-    """`checkpoint_name` (the `name` primitive) under shard_map with
-    check_rep: the seq-parallel LM forward tags its attention output for
-    selective remat inside the sharded region."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.ad_checkpoint import checkpoint_name
-    from jax.sharding import PartitionSpec as P
-
-    mesh = _two_device_mesh()
-    if mesh is None:
-        return "fewer than 2 cpu devices for the shard_map probe"
-    try:
-        from jax.experimental.shard_map import shard_map
-    except ImportError:
-        from jax import shard_map
-
-    def body(a):
-        return checkpoint_name(a * 2.0, "probe")
-
-    f = shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P("x"))
-    try:
-        np.asarray(f(jnp.ones(2, jnp.float32)))
-    except NotImplementedError as e:
-        return (f"shard_map has no replication rule for checkpoint_name "
-                f"on this jax build: {e}")
-    return None
-
-
-def _probe_shard_map_pallas():
-    """A pallas kernel under shard_map with check_rep: ring_flash
-    attention runs the flash kernel inside the sharded region."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import PartitionSpec as P
-
-    from mmlspark_tpu.ops.flash_attention import flash_attention
-
-    mesh = _two_device_mesh()
-    if mesh is None:
-        return "fewer than 2 cpu devices for the shard_map probe"
-    try:
-        from jax.experimental.shard_map import shard_map
-    except ImportError:
-        from jax import shard_map
-
-    def body(q, k, v):
-        return flash_attention(q, k, v, causal=True)
-
-    f = shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P("x"))
-    rng = np.random.default_rng(0)
-    q, k, v = (jnp.asarray(rng.normal(size=(2, 8, 1, 4)), jnp.float32)
-               for _ in range(3))
-    try:
-        np.asarray(f(q, k, v))
-    except NotImplementedError as e:
-        return (f"shard_map has no replication rule for pallas_call on "
-                f"this jax build: {e}")
-    return None
 
 
 def _probe_mp2():
@@ -261,8 +194,6 @@ def _probe_data_service_workers():
 
 _PROBES = {
     "lax_pcast": _probe_lax_pcast,
-    "shard_map_checkpoint_name": _probe_shard_map_checkpoint_name,
-    "shard_map_pallas": _probe_shard_map_pallas,
     "mp2": _probe_mp2,
     "multiprocess_collectives": _probe_multiprocess_collectives,
     "package_installed": _probe_package_installed,

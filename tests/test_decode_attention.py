@@ -132,3 +132,90 @@ def test_auto_interpret_off_tpu_is_reference():
     got = fused_single_query_attention(q, k, v, visible)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=0, atol=0)
+
+
+# ---- softmax-stats variants (seq-sharded decode's merge epilogue) -------
+
+def _merge_halves(fn, q, k, v, visible, **kw):
+    """Run a stats attention `fn` over the two window halves separately
+    and merge — exactly what the seq-sharded decode step does across
+    chips, minus the collectives (axis_name=None exercises the identical
+    merge algebra on stacked per-shard stats)."""
+    l = k.shape[1]
+    halves = [fn(q, k[:, :l // 2], v[:, :l // 2], visible[:, :l // 2],
+                 **{n: (w[:, :l // 2] if w is not None else None)
+                    for n, w in kw.items()}),
+              fn(q, k[:, l // 2:], v[:, l // 2:], visible[:, l // 2:],
+                 **{n: (w[:, l // 2:] if w is not None else None)
+                    for n, w in kw.items()})]
+    acc, m, lsum = (jnp.stack(ts) for ts in zip(*halves))
+    return _merge_stacked(acc, m, lsum)
+
+
+def _merge_stacked(acc, m, lsum):
+    """The cross-chip merge, computed on a host-stacked leading axis:
+    same max/rescale/sum algebra as `merge_attention_stats` under pmax/
+    psum, so the parity it proves carries to the collective form."""
+    m_g = jnp.max(m, axis=0)
+    safe = jnp.where(m_g == -1e30, 0.0, m_g)
+    corr = jnp.where(m == -1e30, 0.0, jnp.exp(m - safe[None]))
+    l_g = jnp.sum(lsum * corr, axis=0)
+    acc_g = jnp.sum(acc * corr[..., None], axis=0)
+    return acc_g / jnp.where(l_g == 0.0, 1.0, l_g)[..., None]
+
+
+def test_reference_stats_merge_matches_whole_window():
+    """Two-shard stats + merge == the whole-window reference read — the
+    numerical contract the seq-sharded decode engine stands on."""
+    from mmlspark_tpu.ops.attention import single_query_attention_stats
+    q, k, v, visible = _case(seed=9)
+    ref = single_query_attention(q, k, v, visible)
+    got = _merge_halves(single_query_attention_stats, q, k, v, visible)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_reference_stats_merge_int8_scales_compose():
+    """Per-shard dequant happens inside the local stats pass, so the
+    merged result equals the whole-window int8 read bit-for-tolerance."""
+    from mmlspark_tpu.ops.attention import single_query_attention_stats
+    q, k, v, visible = _case(seed=10)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    ref = single_query_attention(q, kq, vq, visible, k_scale=ks,
+                                 v_scale=vs)
+    got = _merge_halves(single_query_attention_stats, q, kq, vq, visible,
+                        k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_stats_merge_matches_whole_window():
+    """The fused kernel's emit-stats mode (interpret on CPU): raw
+    (acc, m, l) from two half-windows, merged, equals the normalized
+    whole-window kernel output."""
+    from mmlspark_tpu.ops.decode_attention import (
+        fused_single_query_attention_stats)
+    q, k, v, visible = _case(seed=11)
+    ref = fused_single_query_attention(q, k, v, visible, block_k=64,
+                                       interpret=True)
+    got = _merge_halves(
+        lambda *a, **kw: fused_single_query_attention_stats(
+            *a, block_k=32, interpret=True, **kw),
+        q, k, v, visible)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **TOL)
+
+
+def test_fused_stats_fully_masked_shard_is_identity():
+    """A shard whose visible slots are all False must contribute the
+    merge identity (m=-inf, l=0, acc=0) — decode's early steps leave
+    whole shards unwritten."""
+    from mmlspark_tpu.ops.decode_attention import (
+        fused_single_query_attention_stats)
+    q, k, v, visible = _case(seed=12)
+    masked = jnp.zeros_like(visible)
+    acc, m, lsum = fused_single_query_attention_stats(
+        q, k, v, masked, block_k=64, interpret=True)
+    assert float(jnp.max(jnp.abs(acc))) == 0.0
+    assert float(jnp.max(lsum)) == 0.0
+    assert bool(jnp.all(m <= -1e30))

@@ -84,6 +84,7 @@ def test_bench_contract_schema_declared():
             "checkpoint": bench.bench_checkpoint,
             "lm_train": bench.bench_lm_train,
             "lm_decode": bench.bench_lm_decode,
+            "lm_long_context": bench.bench_lm_long_context,
             "serve": bench.bench_serve,
             "sweep": bench.bench_sweep}
     assert set(arms) == set(bench.CONTRACT_FIELDS)

@@ -1,0 +1,191 @@
+"""Seq-sharded long-context decode (models/generate.py DecodeEngine with a
+mesh whose 'seq' axis > 1): distributed blockwise ring prefill + the
+window-partitioned KV cache with the cross-chip softmax-stats merge must
+be pure LAYOUT — greedy tokens exactly equal the single-chip engine's on
+the virtual CPU mesh (conftest.py), for both cache dtypes — and every
+composition the seq path refuses must refuse loudly at construction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataTable
+from mmlspark_tpu.models import ModelBundle
+from mmlspark_tpu.models.definitions import build_model
+from mmlspark_tpu.models.generate import DecodeEngine, TextGenerator
+from mmlspark_tpu.parallel.mesh import MeshSpec, make_mesh
+
+CFG = {"vocab_size": 32, "d_model": 32, "n_heads": 4, "n_layers": 2,
+       "max_len": 64, "dtype": "float32"}
+
+
+@pytest.fixture(scope="module")
+def lm():
+    module = build_model("TransformerLM", CFG)
+    variables = module.init(jax.random.key(3), np.zeros((1, 4), np.int32))
+    return module, variables
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(0)
+    p = rng.integers(0, CFG["vocab_size"], (2, 8)).astype(np.int32)
+    return p, np.array([8, 5], np.int32)
+
+
+def _seq_mesh(data=1, seq=2):
+    return make_mesh(MeshSpec(data=data, model=1, seq=seq),
+                     jax.devices()[:data * seq])
+
+
+# -------------------------------------------- greedy parity (the pin) ---
+
+@pytest.mark.parametrize("cache_dtype", ["model", "int8"])
+def test_seq2_greedy_matches_single_chip(lm, prompts, cache_dtype):
+    """The contract: a seq=2 engine's greedy tokens are IDENTICAL to the
+    single-chip engine's at model dtype (int8 rides the same pin — both
+    sides quantize the same values, and dequant happens inside the local
+    stats pass, before the merge).  max_new crosses a cache-chunk
+    boundary, so the grown window resharded over 'seq' (ownership
+    rotation) is exercised, not just the prefill layout."""
+    module, variables = lm
+    toks, true_len = prompts
+    ref = DecodeEngine(module, max_new_tokens=12, temperature=0.0,
+                       chunk=16, cache_dtype=cache_dtype).generate(
+        variables, toks, true_len)
+    eng = DecodeEngine(module, max_new_tokens=12, temperature=0.0,
+                       chunk=16, cache_dtype=cache_dtype,
+                       mesh=_seq_mesh())
+    assert eng.seq_shards == 2
+    got = eng.generate(variables, toks, true_len)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_seq2_data2_compose(lm):
+    """'data' x 'seq' 2x2 mesh: batch shards over data, every window
+    shards over seq — tokens still identical to single-chip."""
+    module, variables = lm
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, CFG["vocab_size"], (4, 8)).astype(np.int32)
+    true_len = np.array([8, 3, 6, 8], np.int32)
+    ref = DecodeEngine(module, max_new_tokens=6, temperature=0.0,
+                       chunk=16).generate(variables, toks, true_len)
+    got = DecodeEngine(module, max_new_tokens=6, temperature=0.0,
+                       chunk=16, mesh=_seq_mesh(data=2, seq=2)).generate(
+        variables, toks, true_len)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_seq2_stop_token_early_exit(lm, prompts):
+    """Stop tokens freeze rows and the all-done early exit skips the
+    remaining segments on the seq path exactly as on the single-chip
+    path — same tokens, same repeated-stop tail, same segment skip
+    accounting hooks."""
+    module, variables = lm
+    toks, true_len = prompts
+    stop = int(DecodeEngine(module, max_new_tokens=16, temperature=0.0,
+                            chunk=16).generate(
+        variables, toks, true_len)[0, 2])
+    ref_eng = DecodeEngine(module, max_new_tokens=16, temperature=0.0,
+                           chunk=16, stop_tokens=(stop,))
+    ref = ref_eng.generate(variables, toks, true_len)
+    seq_eng = DecodeEngine(module, max_new_tokens=16, temperature=0.0,
+                           chunk=16, stop_tokens=(stop,),
+                           mesh=_seq_mesh())
+    got = seq_eng.generate(variables, toks, true_len)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # the stop row's tail repeats the stop token (generate()'s contract)
+    row = np.asarray(got[0])
+    hit = np.argmax(row == stop)
+    assert (row[hit:] == stop).all()
+
+
+def test_seq2_sampled_runs(lm, prompts):
+    """Sampled decode on the seq path (typed row keys ride through the
+    shard_map as raw key data): shapes, dtype, and vocabulary range."""
+    module, variables = lm
+    toks, true_len = prompts
+    out = DecodeEngine(module, max_new_tokens=5, temperature=0.8,
+                       top_k=8, chunk=16, mesh=_seq_mesh()).generate(
+        variables, toks, true_len, rng=jax.random.key(7))
+    out = np.asarray(out)
+    assert out.shape == (2, 5)
+    assert ((0 <= out) & (out < CFG["vocab_size"])).all()
+
+
+def test_textgenerator_seq_mesh_end_to_end(lm):
+    """The transform front end drives the seq-sharded engine untouched:
+    ragged rows, data x seq mesh, tokens identical to no-mesh."""
+    module, variables = lm
+    bundle = ModelBundle.from_module(module, variables)
+    rows = np.empty(4, object)
+    for i in range(4):
+        rows[i] = ((np.arange(3 + i, dtype=np.int32) + i)
+                   % CFG["vocab_size"])
+    table = DataTable({"prompt": rows})
+    single = TextGenerator(bundle, inputCol="prompt", outputCol="out",
+                           maxNewTokens=5).transform(table)["out"]
+    meshed = TextGenerator(bundle, inputCol="prompt", outputCol="out",
+                           maxNewTokens=5).set_mesh(
+        _seq_mesh(data=2, seq=2)).transform(table)["out"]
+    for a, b in zip(single, meshed):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------ refusals ---
+
+def test_refusals_at_construction(lm):
+    module, _ = lm
+    mesh = _seq_mesh()
+    with pytest.raises(ValueError, match="chunk.*seq"):
+        DecodeEngine(module, max_new_tokens=4, chunk=15, mesh=mesh)
+    with pytest.raises(ValueError, match="min_bucket.*seq"):
+        DecodeEngine(module, max_new_tokens=4, chunk=16, min_bucket=7,
+                     mesh=mesh)
+    with pytest.raises(ValueError, match="chunked prefill"):
+        DecodeEngine(module, max_new_tokens=4, chunk=16, prefill_chunk=8,
+                     mesh=mesh)
+    with pytest.raises(ValueError, match="model>1"):
+        DecodeEngine(module, max_new_tokens=4, chunk=16,
+                     mesh=make_mesh(MeshSpec(data=1, model=2, seq=2),
+                                    jax.devices()[:4]))
+
+
+def test_refusals_serving_surface(lm, prompts):
+    """Every serving hook refuses a seq-sharded engine, and the row
+    splice refuses a seq mesh — continuous batching assumes whole-window
+    rows."""
+    module, variables = lm
+    eng = DecodeEngine(module, max_new_tokens=4, chunk=16,
+                       mesh=_seq_mesh())
+    toks, true_len = prompts
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.key(0), i))(
+        jnp.arange(2))
+    with pytest.raises(ValueError, match="serve_prefill"):
+        eng.serve_prefill(variables, toks, true_len,
+                          np.ones(2, bool), keys)
+    with pytest.raises(ValueError, match="serve_step"):
+        eng.serve_step(variables, [], jnp.zeros(2, jnp.int32),
+                       jnp.zeros(2, bool), true_len, np.full(2, 4), 8,
+                       np.zeros(2), keys, 4, 16)
+    with pytest.raises(ValueError, match="merge_cache_rows"):
+        DecodeEngine.merge_cache_rows([], [], [0], [0],
+                                      mesh=_seq_mesh())
+
+
+def test_refusal_serving_engine(lm):
+    from mmlspark_tpu.serve.engine import ServingEngine
+    module, variables = lm
+    bundle = ModelBundle.from_module(module, variables)
+    with pytest.raises(ValueError, match="seq-sharded"):
+        ServingEngine(bundle, mesh=_seq_mesh())
+
+
+def test_generate_refuses_unshardable_bucket(lm):
+    module, variables = lm
+    eng = DecodeEngine(module, max_new_tokens=4, chunk=16,
+                       mesh=_seq_mesh())
+    toks = np.zeros((2, 9), np.int32)
+    with pytest.raises(ValueError, match="seq axis"):
+        eng.generate(variables, toks, np.array([9, 9], np.int32))

@@ -42,11 +42,7 @@ def test_dense_attention_causal(qkv):
     assert not np.allclose(out[:, -1], out2[:, -1])
 
 
-@pytest.mark.parametrize("impl", [
-    "ring",
-    pytest.param("ring_flash",
-                 marks=pytest.mark.requires_env("shard_map_pallas")),
-    "ulysses", "dense"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses", "dense"])
 @pytest.mark.parametrize("causal", [False, True])
 def test_seq_parallel_matches_dense(qkv, seq_mesh, impl, causal):
     q, k, v = qkv
@@ -103,7 +99,6 @@ def test_ring_flash_gradients_match(qkv, seq_mesh):
             np.abs(np.asarray(a) - np.asarray(b)).max()
 
 
-@pytest.mark.requires_env("shard_map_checkpoint_name")
 def test_transformer_lm_seq_parallel_forward_matches_dense(seq_mesh):
     """Same weights: dense single-device forward == ring sharded forward."""
     rng = np.random.default_rng(1)
@@ -126,12 +121,7 @@ def test_transformer_lm_seq_parallel_forward_matches_dense(seq_mesh):
         np.abs(got - expected).max()
 
 
-@pytest.mark.requires_env("shard_map_checkpoint_name")
-@pytest.mark.parametrize("impl", [
-    "ring",
-    pytest.param("ring_flash",
-                 marks=pytest.mark.requires_env("shard_map_pallas")),
-    "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
 def test_seq_parallel_lm_train_step(seq_mesh, impl):
     """One seq-parallel train step must run and reduce loss on repetition."""
     rng = np.random.default_rng(2)

@@ -1,6 +1,6 @@
 # Developer entry points (the reference's `runme` + sbt targets,
 # tools/runme/runme.sh:30-52 + src/project/build.scala).
-.PHONY: check check-full test test-full lint bench bench-smoke bench-history chaos-drill serve-drill router-drill data-drill disagg-drill tpu-floors install docs notebooks clean
+.PHONY: check check-full test test-full lint bench bench-smoke bench-history chaos-drill serve-drill router-drill data-drill disagg-drill trace-drill tpu-floors install docs notebooks clean
 
 check:            ## full gate: syntax + lint + suite + dryrun + bench smoke
 	bash scripts/check.sh
@@ -43,6 +43,9 @@ data-drill:       ## data-service chaos scenarios: worker crash re-dispatch, dyn
 
 disagg-drill:     ## disaggregated-tier chaos scenarios: prefill-burst interference, torn/stalled/crashed KV handoff, prefill-tier drain (scripts/disagg_drill.py)
 	python scripts/disagg_drill.py
+
+trace-drill:      ## distributed-tracing drill: one trace id across a crash-mid-handoff failover, waterfall shows both attempts, SLO counts one request (scripts/trace_drill.py)
+	python scripts/trace_drill.py
 
 tpu-floors:       ## throughput/MFU floors on a real TPU chip
 	MMLSPARK_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_perf_floor.py -q

@@ -44,7 +44,7 @@ from mmlspark_tpu.data.service.worker import WorkerCore
 from mmlspark_tpu.observe.metrics import inc_counter
 from mmlspark_tpu.observe.spans import monotonic
 from mmlspark_tpu.observe.telemetry import active_run
-from mmlspark_tpu.observe.trace import trace_event
+from mmlspark_tpu.observe.trace import mint_context, trace_event
 from mmlspark_tpu.resilience.breaker import CircuitOpenError, get_breaker
 from mmlspark_tpu.resilience.chaos import get_injector
 
@@ -181,6 +181,7 @@ class ProcWorker:
         self.alive = True
         self.ready = False          # hello seen + graph sent
         self.split: Optional[_Split] = None
+        self.trace_wire = None      # session TraceContext wire form
 
     def attach(self, conn, buf) -> None:
         self.conn = conn
@@ -194,14 +195,24 @@ class ProcWorker:
         finally:
             self.conn.setblocking(False)
 
-    def send_graph(self, spec: dict) -> None:
-        self._send({"t": "graph", "spec": spec, "sync": False})
+    def send_graph(self, spec: dict, trace=None) -> None:
+        self.trace_wire = trace
+        msg = {"t": "graph", "spec": spec, "sync": False}
+        if trace is not None:
+            msg["trace"] = trace
+        self._send(msg)
         self.ready = True
 
     def assign(self, split: _Split) -> None:
         self.split = split
-        self._send({"t": "split", "id": split.index,
-                    "start": split.start, "stop": split.stop})
+        msg = {"t": "split", "id": split.index,
+               "start": split.start, "stop": split.stop}
+        if self.trace_wire is not None:
+            # the trace context rides every worker frame: the worker
+            # echoes its id on split_end, tying subprocess production
+            # back to the session's waterfall
+            msg["trace"] = self.trace_wire
+        self._send(msg)
 
     def stop(self) -> None:
         self.alive = False
@@ -266,6 +277,7 @@ class ServiceSession:
         self._counters = {"deliveries": 0, "stalls": 0,
                           "stall_s": 0.0, "residency": 0}
         self._run = active_run()
+        self.trace = None           # minted at start() when tracing is on
         self._selector = None
         self._server = None
         self._port: Optional[int] = None
@@ -274,6 +286,9 @@ class ServiceSession:
     # -- telemetry ------------------------------------------------------
     def _event(self, kind: str, **fields) -> None:
         inc_counter(f"data.service.{kind}")
+        if self.trace is not None:
+            fields.setdefault("trace", self.trace.trace_id)
+            fields.setdefault("sampled", self.trace.sampled)
         payload = {"kind": kind, **fields}
         trace_event(f"data.service.{kind}", cat="data", **fields)
         if self._run is not None:
@@ -294,6 +309,9 @@ class ServiceSession:
         if self._started:
             return
         self._started = True
+        # one trace per session: the data tier is its own root span in
+        # the fleet waterfall (kind "admit" opens it, "finish" closes it)
+        self.trace = mint_context()
         self._deadline = monotonic() + float(
             config.get("MMLSPARK_TPU_DATA_SERVICE_START_TIMEOUT"))
         if self.service.mode == "process":
@@ -310,6 +328,9 @@ class ServiceSession:
                     split_elems=self.split_elems, offset=self.offset,
                     consumer=self.consumer_index,
                     consumers=self.num_consumers)
+        if self.trace is not None:
+            self._event("admit", mode=self.service.mode,
+                        workers=self.target_workers)
         self._maybe_dispatch()
 
     def fast_forward(self, n: int) -> bool:
@@ -566,7 +587,9 @@ class ServiceSession:
                 if w.worker_id == wid and w.conn is None and w.alive:
                     w.attach(conn, slot[1])
                     slot[0] = w
-                    w.send_graph(self.spec)
+                    w.send_graph(self.spec,
+                                 None if self.trace is None
+                                 else self.trace.to_wire())
                     self._maybe_dispatch()
                     return
             return
@@ -697,6 +720,10 @@ class ServiceSession:
                                    if s.state == "done"),
                         workers_spawned=self._spawned,
                         redispatches=self._redispatches)
+            if self.trace is not None:
+                self._event("finish",
+                            status="error" if self._error else "ok",
+                            delivered=self._delivered)
 
 
 _PENDING = object()

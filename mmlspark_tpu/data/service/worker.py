@@ -93,9 +93,15 @@ def _serve(sock, worker_id: int, chaos: WorkerChaos) -> None:
     buf = transport.FrameBuffer()
     transport.send_json(sock, {"t": "hello", "worker": worker_id})
     core: Optional[WorkerCore] = None
+    trace_id: Optional[str] = None   # dispatcher's session trace context,
+    #   carried on graph/split frames; echoed on split_end/err so the
+    #   subprocess's production joins the session's fleet waterfall
     while True:
         msg = _recv_json(sock, buf)
         kind = msg.get("t")
+        ctx = msg.get("trace")
+        if isinstance(ctx, dict) and isinstance(ctx.get("id"), str):
+            trace_id = ctx["id"]
         if kind == "stop":
             return
         if kind == "graph":
@@ -117,14 +123,17 @@ def _serve(sock, worker_id: int, chaos: WorkerChaos) -> None:
                     os._exit(17)  # chaos worker_crash: die unacked
                 transport.send_elem(sock, split_id, seq, obj)
                 n += 1
-            transport.send_json(
-                sock, {"t": "split_end", "id": split_id, "n": n,
-                       "produced": core.produced,
-                       "stats": core.last_stats})
+            end = {"t": "split_end", "id": split_id, "n": n,
+                   "produced": core.produced, "stats": core.last_stats}
+            if trace_id is not None:
+                end["trace"] = trace_id
+            transport.send_json(sock, end)
         except Exception as e:  # deterministic graph errors: report, die
-            transport.send_json(
-                sock, {"t": "err", "id": split_id,
-                       "msg": f"{type(e).__name__}: {e}"})
+            err = {"t": "err", "id": split_id,
+                   "msg": f"{type(e).__name__}: {e}"}
+            if trace_id is not None:
+                err["trace"] = trace_id
+            transport.send_json(sock, err)
             return
 
 

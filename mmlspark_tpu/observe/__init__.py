@@ -25,9 +25,14 @@ from mmlspark_tpu.observe.telemetry import (RunTelemetry, active_run,
                                             run_telemetry)
 from mmlspark_tpu.observe.timing import (StageTimings, instrument_stage_method,
                                          stage_timing)
-from mmlspark_tpu.observe.trace import (Span, Tracer, active_tracer,
-                                        current_span_id, trace_event,
-                                        trace_span)
+from mmlspark_tpu.observe.assemble import (assemble, assemble_dir,
+                                           load_shard_set, tracez_payload)
+from mmlspark_tpu.observe.slo import compute_slo
+from mmlspark_tpu.observe.trace import (Span, TraceContext, Tracer,
+                                        active_tracer, current_span_id,
+                                        head_sampled, mint_context,
+                                        new_trace_id, tail_promote,
+                                        trace_event, trace_span)
 
 __all__ = ["LOG_ROOT", "get_logger", "MetricData", "annotate", "profile",
            "StageTimings", "instrument_stage_method", "stage_timing",
@@ -36,6 +41,9 @@ __all__ = ["LOG_ROOT", "get_logger", "MetricData", "annotate", "profile",
            "reset_counters", "counters_metric_data",
            "Span", "Tracer", "active_tracer", "current_span_id",
            "trace_event", "trace_span",
+           "TraceContext", "mint_context", "new_trace_id", "head_sampled",
+           "tail_promote", "compute_slo",
+           "assemble", "assemble_dir", "load_shard_set", "tracez_payload",
            "RunTelemetry", "active_run", "run_telemetry",
            "prometheus_text", "serve_metrics", "write_metrics",
            "capture_program_cost", "costmodel_enabled", "roofline",

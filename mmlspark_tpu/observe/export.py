@@ -100,6 +100,25 @@ def prometheus_text(run: Optional[RunTelemetry] = None) -> str:
                 f"{_fmt(breakers[ep]['consecutive_failures'])}")
 
     if run is not None and run.live:
+        # latency histograms (RunTelemetry.observe_hist): real Prometheus
+        # histogram families — cumulative `le` buckets (a sample counts in
+        # ITS bucket and every larger one, closing with +Inf == _count),
+        # plus _sum/_count, the shape rate()/histogram_quantile() expect
+        for name, h in sorted(run.histograms().items()):
+            metric = _metric_name(name + "_seconds")
+            lines.append(f"# HELP {metric} mmlspark_tpu latency "
+                         f"histogram {name!r} (seconds)")
+            lines.append(f"# TYPE {metric} histogram")
+            cum = 0
+            for bound, count in zip(h["bounds"], h["counts"]):
+                cum += count
+                lines.append(f'{metric}_bucket{{le="{_fmt(bound)}"}} '
+                             f"{cum}")
+            cum += h["counts"][-1]
+            lines.append(f'{metric}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{metric}_sum {_fmt(h['sum'])}")
+            lines.append(f"{metric}_count {h['count']}")
+
         for name, g in sorted(run.gauges().items()):
             metric = _metric_name(name)
             lines.append(f"# HELP {metric} mmlspark_tpu run gauge "

@@ -65,7 +65,7 @@ _HIGHER = ("value", "mfu", "device_mfu", "accuracy", "agreement",
            "hbm_bw_util")
 _HIGHER_SUFFIX = ("_per_sec", "_per_chip", "_speedup", "_agreement",
                   "_accuracy", "_images_per_sec", "_tokens_per_sec")
-_LOWER = ("telemetry_overhead", "train_wall_s")
+_LOWER = ("telemetry_overhead", "trace_overhead", "train_wall_s")
 _LOWER_SUFFIX = ("_step_ms", "_ms")
 
 

@@ -82,8 +82,12 @@ def _stage_timings(events: Iterable[dict]) -> Optional[PipelineTimings]:
     return timings
 
 
-def build_report(events: list[dict], top: int = 5) -> dict:
-    """The structured report over a parsed event list."""
+def build_report(events: list[dict], top: int = 5,
+                 source: Optional[str] = None) -> dict:
+    """The structured report over a parsed event list.  `source` (the
+    run directory, when known) widens the `requests` section to every
+    shard under it — a fleet run writes one run.jsonl per process, and
+    the waterfalls only stitch across them."""
     spans = [e for e in events if e.get("type") == "span"]
     instants = [e for e in events if e.get("type") == "event"]
     counters = {}
@@ -121,7 +125,30 @@ def build_report(events: list[dict], top: int = 5) -> dict:
         "programs": _programs(events),
         "numerics": numerics,
         "resilience": resilience,
+        "requests": _requests(events, top, source),
+        "slo_alerts": [e for e in events
+                       if e.get("type") == "slo_alert"],
         "counters": counters,
+    }
+
+
+def _requests(events: list[dict], top: int,
+              source: Optional[str]) -> dict:
+    """The distributed-tracing section: top-N slowest request waterfalls
+    (observe/assemble.py).  Cross-process runs shard their timelines one
+    run.jsonl per process; given the run DIRECTORY we stitch every shard
+    under it, torn or missing shards degrading to notes — a crashed
+    worker's half-written shard must never sink the report."""
+    from mmlspark_tpu.observe.assemble import assemble, assemble_dir
+    if source is not None and os.path.isdir(source):
+        asm = assemble_dir(source)
+    else:
+        asm = assemble(events)
+    return {
+        "total": len(asm["waterfalls"]),
+        "orphans": len(asm["orphans"]),
+        "degraded": asm["degraded"],
+        "slowest": asm["waterfalls"][:max(0, top)],
     }
 
 
@@ -236,6 +263,41 @@ def render_report(report: dict) -> str:
     if not report["resilience"]:
         lines.append("  (no retries / preemptions / chaos)")
 
+    req = report.get("requests") or {}
+    if req.get("total") or req.get("orphans") or req.get("degraded"):
+        lines.append("")
+        lines.append(f"-- requests: slowest traces "
+                     f"({len(req.get('slowest', []))} of "
+                     f"{req.get('total', 0)}, "
+                     f"{req.get('orphans', 0)} orphaned) --")
+        for w in req.get("slowest", []):
+            stages = " ".join(
+                f"{name}={dur * 1e3:.2f}ms"
+                for name, dur in sorted((w.get("stages") or {}).items(),
+                                        key=lambda kv: -kv[1]))
+            flags = []
+            if w.get("degraded"):
+                flags.append("DEGRADED")
+            if w.get("tail"):
+                flags.append(f"tail:{w['tail']}")
+            lines.append(
+                f"  {w['trace'][:16]}  {w.get('wall_s', 0) * 1e3:9.2f}ms  "
+                f"{w.get('status') or '?':<8} x{w.get('attempts', 1)}  "
+                f"{stages}"
+                + (("  [" + " ".join(flags) + "]") if flags else ""))
+        for note in req.get("degraded", []):
+            lines.append(f"  (degraded: {note})")
+
+    alerts = report.get("slo_alerts") or []
+    if alerts:
+        lines.append("")
+        lines.append(f"-- SLO burn alerts ({len(alerts)}) --")
+        for a in alerts:
+            lines.append(
+                f"  {a.get('endpoint')}: burn fast={a.get('burn_fast')} "
+                f"slow={a.get('burn_slow')} "
+                f"(threshold {a.get('threshold')})")
+
     if report["counters"]:
         lines.append("")
         lines.append("-- counter deltas --")
@@ -260,7 +322,7 @@ def main(argv: Optional[list] = None) -> int:
     if not events:
         print(f"no events in {args.run}")
         return 1
-    report = build_report(events, top=args.top)
+    report = build_report(events, top=args.top, source=args.run)
     if args.format == "json":
         print(json.dumps(report, indent=2, sort_keys=True, default=str))
     else:

@@ -35,6 +35,7 @@ read, and every instrumented hot path gates on it (or on
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import contextvars
 import json
@@ -55,6 +56,13 @@ TELEMETRY_DIR = config.TELEMETRY_DIR
 
 _active: contextvars.ContextVar[Optional["RunTelemetry"]] = \
     contextvars.ContextVar("mmlspark_tpu_run_telemetry", default=None)
+
+# latency histogram bucket bounds (seconds) shared by every observe_hist
+# family — fixed at declaration so counts are O(1) per sample and two
+# shards of one fleet always bucket identically (Prometheus `le` is <=,
+# so a sample exactly on a bound lands IN that bound's bucket)
+HIST_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 def telemetry_enabled() -> bool:
@@ -81,6 +89,11 @@ class RunTelemetry:
         self.timings = PipelineTimings()
         self._counters0 = counters_snapshot() if live else {}
         self._gauges: dict[str, dict] = {}
+        # latency histograms (observe_hist): incremental per-bucket counts
+        # against the fixed HIST_BUCKETS bounds + sum/count/min/max — O(1)
+        # memory per family no matter how many samples, which is what lets
+        # the serve hot path record TTFT/inter-token without a ring
+        self._hists: dict[str, dict] = {}
         # per-program cost/time tables (observe/costmodel.py): costs from
         # compile-time cost_analysis capture, times accumulated by the hot
         # loops at each execution, keyed (where, program) on both sides so
@@ -148,6 +161,38 @@ class RunTelemetry:
 
     def gauges(self) -> dict[str, dict]:
         return {k: dict(v) for k, v in self._gauges.items()}
+
+    # -- latency histograms -----------------------------------------------
+    def observe_hist(self, name: str, value) -> None:
+        """Record one latency sample into the named histogram family
+        (bounded state: per-bucket counts + sum/count/min/max, never raw
+        samples).  observe/export.py renders these as cumulative
+        Prometheus `_bucket`/`_sum`/`_count` series."""
+        if not self.live:
+            return
+        value = float(value)
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = {
+                "counts": [0] * (len(HIST_BUCKETS) + 1),
+                "sum": 0.0, "count": 0, "min": value, "max": value}
+        h["counts"][bisect.bisect_left(HIST_BUCKETS, value)] += 1
+        h["sum"] += value
+        h["count"] += 1
+        if value < h["min"]:
+            h["min"] = value
+        elif value > h["max"]:
+            h["max"] = value
+
+    def histograms(self) -> dict[str, dict]:
+        """{name: {bounds, counts, sum, count, min, max}} — counts are
+        per-bucket (NOT cumulative; exposition cumulates), the last slot
+        being the +Inf overflow bucket."""
+        return {name: {"bounds": list(HIST_BUCKETS),
+                       "counts": list(h["counts"]),
+                       "sum": round(h["sum"], 6), "count": h["count"],
+                       "min": round(h["min"], 6), "max": round(h["max"], 6)}
+                for name, h in self._hists.items()}
 
     def sample_memory(self, tag: str = "sample") -> dict:
         """Gauge each local device's memory_stats bytes_in_use /
@@ -330,12 +375,31 @@ class RunTelemetry:
             return self._finished
         return self._build_summary()
 
+    def _slo_summary(self) -> dict:
+        """Per-endpoint SLO compliance + burn rates from the serve and
+        routing timelines (observe/slo.py, imported lazily so runs that
+        never serve pay nothing).  Never allowed to fail the summary."""
+        if not (self._serve or self._routing):
+            return {}
+        try:
+            from mmlspark_tpu.observe.slo import compute_slo
+            return compute_slo(self._serve, self._routing,
+                               now=self.tracer.now())
+        except Exception:
+            from mmlspark_tpu.observe.logging import get_logger
+            get_logger("observe").warning(
+                "SLO rollup failed; omitting `slo` from run summary",
+                exc_info=True)
+            return {}
+
     def _build_summary(self) -> dict:
         return {
             "wall_s": round(time.perf_counter() - self._t0, 4),
             "wall_time_start": self.tracer.wall0,
             "counters": self.counter_deltas(),
             "gauges": self.gauges(),
+            "histograms": self.histograms(),
+            "slo": self._slo_summary(),
             "spans": self.tracer.span_aggregates(),
             "stage_timings": self.timings.summary(),
             "programs": self.program_summary(),
@@ -369,6 +433,10 @@ class RunTelemetry:
         self.sample_memory(tag="end")
         summary = self._build_summary()
         ts = round(self.tracer.now(), 6)
+        for alert in summary.get("slo", {}).get("alerts", []):
+            # burn-rate alerts ride the stream too, so run.jsonl replays
+            # them without re-deriving the windows
+            self.tracer._record({"type": "slo_alert", "ts": ts, **alert})
         self.tracer._record({"type": "counters", "ts": ts,
                              "deltas": summary["counters"]})
         self.tracer._record({"type": "stage_timings", "ts": ts,

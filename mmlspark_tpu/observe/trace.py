@@ -40,13 +40,37 @@ import contextlib
 import contextvars
 import itertools
 import json
+import os
 import threading
 import time
 from collections import deque
 from typing import Any, Iterator, Optional
 
+from mmlspark_tpu import config
+
 DEFAULT_RING = 4096  # completed records kept in memory (the JSONL sink,
 # when configured, has already persisted everything that scrolls off)
+
+TRACE = config.register(
+    "MMLSPARK_TPU_TRACE", True,
+    "distributed tracing: propagate a per-request TraceContext through "
+    "router dispatch, the KV handoff, and the data-service worker frames "
+    "(0 disables the context plumbing; span/event recording under "
+    "run_telemetry is governed by MMLSPARK_TPU_TELEMETRY)", ptype=bool)
+TRACE_SAMPLE = config.register(
+    "MMLSPARK_TPU_TRACE_SAMPLE", 1.0,
+    "distributed tracing: head-sampled fraction of requests that keep "
+    "full per-stage span detail in assembled waterfalls; the bit is "
+    "derived from the trace id, so every tier of a fleet derives the "
+    "SAME decision with no coordination.  Requests outside the fraction "
+    "are still tail-promoted when they finish slow/shed/errored/hedged",
+    ptype=float)
+TRACE_SLOW_S = config.register(
+    "MMLSPARK_TPU_TRACE_SLOW_S", 1.0,
+    "distributed tracing: tail-sampling latency threshold — a request "
+    "outside the head-sampled fraction that completes slower than this "
+    "(seconds) is promoted to full-detail anyway (slow requests are "
+    "exactly the ones worth a waterfall)", ptype=float)
 
 _tracer_var: contextvars.ContextVar[Optional["Tracer"]] = \
     contextvars.ContextVar("mmlspark_tpu_tracer", default=None)
@@ -308,3 +332,136 @@ def span_on_tracer(tracer: Optional[Tracer], name: str,
     if tracer is None:
         return contextlib.nullcontext()
     return tracer.span(name, parent=parent, cat=cat, **attrs)
+
+
+# -- distributed trace context (fleet-wide request tracing) -----------------
+#
+# A request that crosses a socket seam (data-service worker frames, the
+# KV handoff, the HTTP front door) loses its span parentage: span ids are
+# per-tracer integers with no cross-process meaning.  TraceContext is the
+# Dapper-style identity that survives the wire — a 16-byte trace id (the
+# request, everywhere), the sender-side parent span id (stitching hint),
+# and the sampling bit — carried as one small JSON field on the existing
+# control frames and re-attached on the far side.  observe/assemble.py
+# joins shard records back into per-request waterfalls on the trace id.
+
+
+def trace_enabled() -> bool:
+    """The MMLSPARK_TPU_TRACE master switch for context propagation."""
+    return bool(TRACE.current())
+
+
+def new_trace_id() -> str:
+    """Mint one 16-byte trace id as 32 lowercase hex chars.
+
+    THE ONE SANCTIONED ID MINT: scripts/lint.py forbids uuid/secrets/
+    os.urandom id generation everywhere else under mmlspark_tpu/, so
+    cross-process stitching can rely on exactly this format."""
+    return os.urandom(16).hex()
+
+
+def head_sampled(trace_id: str, fraction: float) -> bool:
+    """The head-sampling decision, derived FROM the trace id (first 4
+    bytes as a uniform in [0, 1)): every tier of a fleet — router,
+    prefill, decode, data-service workers — computes the same bit from
+    the id alone, so the decision is consistent with no coordination
+    and pinned across failover by construction."""
+    if fraction >= 1.0:
+        return True
+    if fraction <= 0.0:
+        return False
+    return int(trace_id[:8], 16) / float(1 << 32) < fraction
+
+
+class TraceContext:
+    """One request's cross-process trace identity (module comment above).
+
+    `sampled` is the HEAD decision and is immutable for the request's
+    lifetime (the satellite consistency pin); tail promotion at
+    completion is a separate `trace.tail_sample` event, never a flipped
+    bit mid-flight.  `attempt` counts dispatch attempts (1-based) so a
+    failover re-uses the trace id with a new attempt span."""
+
+    __slots__ = ("trace_id", "parent_span", "sampled", "attempt")
+
+    def __init__(self, trace_id: str, parent_span: Optional[int] = None,
+                 sampled: bool = True, attempt: int = 1):
+        self.trace_id = str(trace_id)
+        self.parent_span = parent_span
+        self.sampled = bool(sampled)
+        self.attempt = int(attempt)
+
+    def child(self, parent_span: Optional[int] = None,
+              attempt: Optional[int] = None) -> "TraceContext":
+        """Same trace id and sampling bit, new stitching point."""
+        return TraceContext(
+            self.trace_id,
+            self.parent_span if parent_span is None else parent_span,
+            self.sampled,
+            self.attempt if attempt is None else attempt)
+
+    def to_wire(self) -> dict:
+        """The JSON control field that rides hello/graph/split frames and
+        the kv_begin header."""
+        return {"id": self.trace_id, "parent": self.parent_span,
+                "sampled": self.sampled, "attempt": self.attempt}
+
+    @classmethod
+    def from_wire(cls, obj) -> Optional["TraceContext"]:
+        """Parse the wire field; anything malformed degrades to None
+        (an untraced request) rather than failing the frame."""
+        if not isinstance(obj, dict):
+            return None
+        tid = obj.get("id")
+        if not isinstance(tid, str) or not tid:
+            return None
+        parent = obj.get("parent")
+        if not isinstance(parent, int):
+            parent = None
+        try:
+            attempt = max(1, int(obj.get("attempt", 1)))
+        except (TypeError, ValueError):
+            attempt = 1
+        return cls(tid, parent, bool(obj.get("sampled", True)), attempt)
+
+    def attrs(self) -> dict:
+        """The standard span/event attribute triple every traced record
+        carries (assemble joins on `trace`)."""
+        return {"trace": self.trace_id, "sampled": self.sampled,
+                "attempt": self.attempt}
+
+    def __repr__(self) -> str:
+        return (f"TraceContext({self.trace_id[:8]}…, "
+                f"attempt={self.attempt}, sampled={self.sampled})")
+
+
+def mint_context() -> Optional[TraceContext]:
+    """Mint a fresh root context (router admission, bare-engine submit,
+    data-service session start), or None when tracing is off — callers
+    thread the None through and every downstream site stays untraced."""
+    if not trace_enabled():
+        return None
+    tid = new_trace_id()
+    return TraceContext(
+        tid, sampled=head_sampled(tid, float(TRACE_SAMPLE.current())))
+
+
+def tail_promote(ctx: Optional[TraceContext], *, status: str,
+                 latency_s: Optional[float], hedged: bool = False,
+                 retries: int = 0) -> Optional[str]:
+    """The tail-sampling decision at request completion: a head-unsampled
+    request that finished slow, shed, errored, timed out, hedged, or
+    retried is worth full detail after all.  Returns the promotion
+    reason (assemble keeps full waterfalls for promoted traces) or None;
+    head-sampled requests need no promotion."""
+    if ctx is None or ctx.sampled:
+        return None
+    if status not in ("ok",):
+        return status
+    if hedged:
+        return "hedged"
+    if retries > 0:
+        return "retried"
+    if latency_s is not None and latency_s > float(TRACE_SLOW_S.current()):
+        return "slow"
+    return None

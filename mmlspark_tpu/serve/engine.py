@@ -52,7 +52,8 @@ from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.observe.metrics import inc_counter
 from mmlspark_tpu.observe.spans import monotonic
 from mmlspark_tpu.observe.telemetry import active_run
-from mmlspark_tpu.observe.trace import span_on_tracer, trace_event
+from mmlspark_tpu.observe.trace import (mint_context, span_on_tracer,
+                                        tail_promote, trace_event)
 from mmlspark_tpu.resilience.clock import Clock, get_clock
 from mmlspark_tpu.serve.admission import (AdmissionController,
                                           InvalidRequest, MissRateBreaker,
@@ -655,13 +656,15 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               priority: Optional[str] = None) -> Request:
+               priority: Optional[str] = None, trace=None) -> Request:
         """Admit one request or raise (`InvalidRequest` for poison,
         `Overloaded` when shed).  `priority` picks the admission lane
         ('interactive', the default, or 'batch' — weighted shedding
-        costs the batch lane first under overload).  Returns the live
-        `Request`; callers block on `request.wait()` or poll
-        `request.finished`."""
+        costs the batch lane first under overload).  `trace` is an
+        upstream TraceContext (the router's per-attempt child); a bare
+        engine mints its own root and records the waterfall's `admit`
+        event itself.  Returns the live `Request`; callers block on
+        `request.wait()` or poll `request.finished`."""
         if not self.alive:
             self._count("shed_draining")
             self._count("shed")
@@ -711,11 +714,23 @@ class ServingEngine:
         if req.degraded:
             self._count("degraded")
             self._record_serve({"event": "degraded", "request": req.id})
+        if trace is not None:
+            req.trace = trace
+        else:
+            # no router tier above: this engine IS the front door, so it
+            # mints the root context and records the waterfall's `admit`
+            req.trace = mint_context()
+            if req.trace is not None:
+                self._record_serve({"event": "admit", "request": req.id,
+                                    "priority": pri, "bucket": bucket,
+                                    "trace": req.trace.trace_id,
+                                    "sampled": req.trace.sampled})
         if self._tracer is not None:
             req.span = self._tracer.span(
                 "serve.request", cat="serve", request=req.id,
                 bucket=bucket, prompt_len=arr.size, new_tokens=n_new,
-                deadline_in_s=round(deadline - now, 4))
+                deadline_in_s=round(deadline - now, 4),
+                **self._trace_fields(req))
         with self._wake:
             self._wake.notify_all()
         return req
@@ -730,6 +745,14 @@ class ServingEngine:
     def _record_serve(self, event: dict) -> None:
         if self._run is not None:
             self._run.record_serve(event)
+
+    @staticmethod
+    def _trace_fields(req: Request) -> dict:
+        """The trace join fields a serve event/span carries (empty for an
+        untraced request) — observe/assemble.py groups on `trace`."""
+        t = getattr(req, "trace", None)
+        return {"trace": t.trace_id, "sampled": t.sampled,
+                "attempt": t.attempt} if t is not None else {}
 
     def _record_prefix(self, event: dict) -> None:
         if self._run is not None:
@@ -825,10 +848,19 @@ class ServingEngine:
         # batch sheds); gated so the no-telemetry hot path never builds
         # the dict
         if self._run is not None:
-            self._record_serve({
+            rec = {
                 "event": "finish", "request": req.id, "status": status,
                 "priority": getattr(req, "priority", INTERACTIVE),
-                "deadline_miss": bool(missed)})
+                "deadline_miss": bool(missed),
+                "latency_s": round(now - req.arrival, 6),
+                **self._trace_fields(req)}
+            # tail-based sampling: a head-unsampled attempt that finished
+            # badly or slow is promoted to full waterfall detail
+            tail = tail_promote(getattr(req, "trace", None), status=status,
+                                latency_s=now - req.arrival)
+            if tail:
+                rec["tail"] = tail
+            self._record_serve(rec)
         self._count("finished")
         self._count(status)
         if status == OK:
@@ -1095,10 +1127,16 @@ class ServingEngine:
                 variables, job["prompts"], job["true_len"], job["index"],
                 job["state"])
         job["elapsed"] += monotonic() - t0
-        self._record_serve({"event": "prefill_chunk", "bucket": g.bucket,
-                            "lane": lane, "index": job["index"],
-                            "chunks": job["chunks"],
-                            "requests": [r.id for r in job["reqs"]]})
+        if self._run is not None:
+            rec = {"event": "prefill_chunk", "bucket": g.bucket,
+                   "lane": lane, "index": job["index"],
+                   "chunks": job["chunks"],
+                   "requests": [r.id for r in job["reqs"]]}
+            traces = [r.trace.trace_id for r in job["reqs"]
+                      if getattr(r, "trace", None) is not None]
+            if traces:
+                rec["traces"] = traces
+            self._record_serve(rec)
         job["index"] += 1
         if job["index"] < job["chunks"]:
             return
@@ -1146,7 +1184,8 @@ class ServingEngine:
             for req in reqs:
                 self._count("handoffs")
                 trace_event("serve.handoff_out", cat="serve",
-                            request=req.id, bucket=g.bucket, lane=lane)
+                            request=req.id, bucket=g.bucket, lane=lane,
+                            **self._trace_fields(req))
                 req.finish(HANDOFF, now)
             return
         if g.caches is None:
@@ -1171,10 +1210,17 @@ class ServingEngine:
             g.row_ids[slot] = req.id
             g.done[slot] = False
             trace_event("serve.join", cat="serve", request=req.id,
-                        bucket=g.bucket, slot=slot, lane=lane)
+                        bucket=g.bucket, slot=slot, lane=lane,
+                        **self._trace_fields(req))
             self._record_serve({"event": "join", "request": req.id,
                                 "bucket": g.bucket, "slot": slot,
-                                "lane": lane})
+                                "lane": lane, **self._trace_fields(req)})
+            if self._run is not None:
+                # attempt-level TTFT: arrival at THIS engine to its first
+                # emitted token (the fleet-level TTFT, arrival at the
+                # router to the decode-tier splice, lands in handoff.py)
+                self._run.observe_hist("serve.ttft_s",
+                                       self.now() - req.arrival)
             self._emit(g, slot, [int(tok_h[j])])
         if self._prefix is not None and lane == "primary":
             self._insert_prefix_rows(reqs, src, caches)
@@ -1230,13 +1276,15 @@ class ServingEngine:
 
     def splice_remote(self, prompt: np.ndarray, max_new_tokens: int,
                       deadline: float, first_tok: int, src_caches,
-                      lane: str = "primary") -> Optional[Request]:
+                      lane: str = "primary", trace=None) -> Optional[Request]:
         """Seat one handed-off row (decode tier): merge the deserialized
         1-row cache into this engine's resident batch via the jitted
         `merge_cache_rows` and decode it to completion like any join.
-        Returns the seated engine Request, or None when no slot is free
-        or the engine is not alive — the handoff bus retries next tick
-        (bounded by the transfer timeout and the request deadline)."""
+        `trace` is the TraceContext that rode the kv_begin header — the
+        decode attempt keeps the fleet request's trace id.  Returns the
+        seated engine Request, or None when no slot is free or the
+        engine is not alive — the handoff bus retries next tick (bounded
+        by the transfer timeout and the request deadline)."""
         if not self.alive:
             return None
         eng = self._engines[lane]
@@ -1253,6 +1301,7 @@ class ServingEngine:
         now = self.now()
         req = Request(self._new_id(), arr, bucket, max_new_tokens, now,
                       float(deadline))
+        req.trace = trace
         if g.caches is None:
             g.caches = self._empty_caches(eng.module, g.capacity, bucket,
                                           kind=eng.cache_dtype)
@@ -1267,9 +1316,11 @@ class ServingEngine:
         g.done[slot] = False
         self._count("remote_joins")
         trace_event("serve.handoff_in", cat="serve", request=req.id,
-                    bucket=bucket, slot=slot, lane=lane)
+                    bucket=bucket, slot=slot, lane=lane,
+                    **self._trace_fields(req))
         self._record_serve({"event": "remote_join", "request": req.id,
-                            "bucket": bucket, "slot": slot, "lane": lane})
+                            "bucket": bucket, "slot": slot, "lane": lane,
+                            **self._trace_fields(req)})
         self._emit(g, slot, [int(first_tok)])
         if self._prefix is not None and lane == "primary":
             # the pool lives on the DECODE tier of a disaggregated
@@ -1326,9 +1377,14 @@ class ServingEngine:
             toks_h = np.asarray(toks)
             tok_h = np.asarray(tok)
             done_h = np.asarray(done)
-        self.estimator.observe_step(g.bucket, (monotonic() - t0) / seg)
+        elapsed = monotonic() - t0
+        self.estimator.observe_step(g.bucket, elapsed / seg)
         self._record_serve({"event": "segment", "bucket": g.bucket,
                             "lane": lane, "rows": len(live)})
+        if self._run is not None:
+            # per-token pacing: one sample per segment (segment wall over
+            # its decode steps), not per token — bounded-cost by design
+            self._run.observe_hist("serve.inter_token_s", elapsed / seg)
         g.caches = caches
         g.tok = tok_h.astype(np.int32)
         g.done = done_h.astype(bool)
@@ -1382,6 +1438,9 @@ class ServingEngine:
         self._record_serve({"event": "segment", "bucket": g.bucket,
                             "lane": lane, "rows": len(live),
                             "spec": True, "emitted": emitted})
+        if self._run is not None:
+            self._run.observe_hist("serve.inter_token_s",
+                                   elapsed / max(1.0, per_row))
         g.caches = caches
         g.draft_caches = draft_caches
         g.tok = tok_h.astype(np.int32)

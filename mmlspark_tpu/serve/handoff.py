@@ -63,7 +63,7 @@ from mmlspark_tpu.models.generate import (deserialize_cache_row,
 from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.observe.metrics import inc_counter
 from mmlspark_tpu.observe.telemetry import active_run
-from mmlspark_tpu.observe.trace import trace_event
+from mmlspark_tpu.observe.trace import TraceContext, trace_event
 from mmlspark_tpu.resilience.breaker import CircuitOpenError
 from mmlspark_tpu.resilience.chaos import get_injector
 from mmlspark_tpu.serve.request import TIMEOUT
@@ -317,15 +317,22 @@ class HandoffBus:
                     t.crash_after = True
         self.transfers[rr.id] = t
         link = self._links[(prefill_name, dec.name)]
-        link.sender.queue(encode_json({
+        header = {
             "t": "kv_begin", "req": rr.id, "from": prefill_name,
             "lane": lane, "bucket": bucket, "pages": len(pages),
             "bytes": t.bytes_total, "first_tok": first_tok,
             "max_new": rr.max_new_tokens, "deadline": rr.deadline,
-            "prompt": [int(x) for x in rr.prompt.tolist()]}))
+            "prompt": [int(x) for x in rr.prompt.tolist()]}
+        if rr.trace is not None:
+            # the trace context rides the wire with the cache header: the
+            # decode-side splice resumes the SAME trace id (new attempt
+            # span), so the fleet waterfall shows one request end to end
+            header["trace"] = rr.trace.to_wire()
+        link.sender.queue(encode_json(header))
         self._record("begin", request=rr.id, prefill=prefill_name,
                      decode=dec.name, pages=len(pages),
-                     bytes=t.bytes_total, probe=probe)
+                     bytes=t.bytes_total, probe=probe,
+                     **self._router._trace_fields(rr))
 
     # -- the per-tick pump -------------------------------------------------
     def pump(self, now: float, compute_worked: bool = False) -> bool:
@@ -487,11 +494,15 @@ class HandoffBus:
                 {"t": "kv_nack", "req": rid,
                  "error": f"page decode failed: {e}"}))
             return True
+        # rehydrate the trace context from the wire header: the decode
+        # attempt continues the SAME trace id as a new attempt span
+        ctx = TraceContext.from_wire(meta.get("trace"))
         req = rep.engine.splice_remote(
             np.asarray(meta["prompt"], dtype=np.int32),
             int(meta["max_new"]), float(meta["deadline"]),
             int(meta["first_tok"]), caches,
-            lane=meta.get("lane", "primary"))
+            lane=meta.get("lane", "primary"),
+            trace=None if ctx is None else ctx.child(attempt=ctx.attempt + 1))
         if req is None:
             # decode batch full; keep the pages resident and tell the
             # sender we are alive so its watchdog holds off
@@ -560,9 +571,16 @@ class HandoffBus:
         self._spliced += 1
         wall = max(0.0, now - t.started)
         self._router.estimator.observe_handoff(t.bucket, wall)
+        if self._run is not None:
+            self._run.observe_hist("serve.handoff_transfer_s", wall)
+            # fleet-level TTFT: the decode seat resumes with prefill's
+            # first token already in hand, so splice time IS first-token
+            # time for a disaggregated request
+            self._run.observe_hist("serve.ttft_s", now - rr.arrival)
         self._record("splice", request=rid, prefill=t.prefill,
                      decode=decode_name, pages=len(t.pages),
-                     bytes=t.bytes_total, wall_s=round(wall, 6))
+                     bytes=t.bytes_total, wall_s=round(wall, 6),
+                     **self._router._trace_fields(rr))
         self._router._record_routing("handoff_splice", request=rid,
                                      replica=decode_name,
                                      attempt=len(rr.attempts))
@@ -600,7 +618,8 @@ class HandoffBus:
         self._retries += 1
         self._record("transfer_failed", request=t.rid, prefill=t.prefill,
                      decode=t.decode, reason=reason,
-                     pages_sent=t.next_page, pages_acked=len(t.acked))
+                     pages_sent=t.next_page, pages_acked=len(t.acked),
+                     **self._router._trace_fields(t.rr))
         if notify_receiver:
             link = self._links.get((t.prefill, t.decode))
             if link is not None and link.sender.alive:

@@ -114,9 +114,40 @@ def make_handler(engine):
                                      "state": engine.state})
             elif path == "/statz":
                 self._json(200, engine.stats())
+            elif path == "/tracez":
+                # live waterfall view of the run's slowest requests
+                # (observe/assemble): a debug surface, so the import
+                # stays lazy and a missing run degrades to an
+                # explanatory payload rather than an error.  The run
+                # handle comes from the engine (captured on ITS thread
+                # at construction) — contextvars don't cross into the
+                # server's handler threads, the explicit-handle rule
+                # every worker-thread consumer in observe/ follows
+                from mmlspark_tpu.observe.assemble import tracez_payload
+                from mmlspark_tpu.observe.telemetry import active_run
+                try:
+                    top = int(self.path.split("top=")[1].split("&")[0]) \
+                        if "top=" in self.path else 10
+                except ValueError:
+                    top = 10
+                run = getattr(engine, "_run", None) or active_run()
+                self._json(200, tracez_payload(run, top=top))
             else:
-                self.send_error(404, "unknown path "
-                                "(healthz | readyz | statz | generate)")
+                self.send_error(
+                    404, "unknown path "
+                    "(healthz | readyz | statz | tracez | generate)")
+
+        @staticmethod
+        def _trace_headers(req, extra: dict = None) -> dict:
+            """Response headers for one request: the distributed trace id
+            (when tracing minted one) plus any status-specific extras —
+            a client can quote X-Request-Trace to find its waterfall in
+            /tracez or the run report."""
+            headers = dict(extra or {})
+            t = getattr(req, "trace", None)
+            if t is not None:
+                headers["X-Request-Trace"] = t.trace_id
+            return headers
 
         # -- the request front end -------------------------------------
         def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
@@ -159,7 +190,8 @@ def make_handler(engine):
             req.wait(budget)
             if not req.finished:
                 self._json(504, {"error": "request did not finish",
-                                 "request": req.id})
+                                 "request": req.id},
+                           self._trace_headers(req))
                 return
             if req.status == OK:
                 self._json(200, {
@@ -167,26 +199,31 @@ def make_handler(engine):
                     "request": req.id,
                     "degraded": bool(req.degraded),
                     "met_deadline": req.finished_at <= req.deadline,
-                    "latency_ms": round(req.latency_s() * 1e3, 3)})
+                    "latency_ms": round(req.latency_s() * 1e3, 3)},
+                    self._trace_headers(req))
             elif req.status == TIMEOUT:
                 self._json(504, {"error": "deadline exceeded",
-                                 "request": req.id})
+                                 "request": req.id},
+                           self._trace_headers(req))
             elif req.status == CANCELLED:
                 self._json(503, {"error": "cancelled: engine draining",
                                  "request": req.id},
-                           {"Retry-After":
-                            f"{engine.retry_after_s():.3f}"})
+                           self._trace_headers(req, {
+                               "Retry-After":
+                               f"{engine.retry_after_s():.3f}"}))
             elif req.status == SHED:
                 # router retry-budget exhaustion after admission: the
                 # same 429 contract as front-door shedding
                 self._json(429, {"error": req.detail or "shed",
                                  "reason": "retry_budget",
                                  "request": req.id},
-                           {"Retry-After":
-                            f"{max(0.1, req.retry_after_s):.3f}"})
+                           self._trace_headers(req, {
+                               "Retry-After":
+                               f"{max(0.1, req.retry_after_s):.3f}"}))
             else:
                 self._json(500, {"error": req.detail or "internal error",
-                                 "request": req.id})
+                                 "request": req.id},
+                           self._trace_headers(req))
 
         # -- token streaming -------------------------------------------
         def _chunk(self, payload: dict) -> None:
@@ -203,6 +240,8 @@ def make_handler(engine):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
+                for k, v in self._trace_headers(req).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 start = time.monotonic()
                 epoch, toks, fin = req.stream_state()

@@ -183,17 +183,20 @@ class Replica:
     # -- submission / scheduling ------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               priority: Optional[str] = None) -> Request:
+               priority: Optional[str] = None, trace=None) -> Request:
         """Route one attempt to this replica's engine; raises
         `ReplicaUnavailable` when the handle knows the engine is dead
-        (crashed/hung) — the router records it as a dispatch failure."""
+        (crashed/hung) — the router records it as a dispatch failure.
+        `trace` is the router's per-attempt TraceContext child: the
+        engine attempt inherits the fleet request's trace id."""
         if self._crashed:
             raise ReplicaUnavailable(
                 f"replica {self.name} crashed: {self._crash_detail}")
         if self._hung:
             raise ReplicaUnavailable(f"replica {self.name} is hung")
         return self.engine.submit(prompt, max_new_tokens,
-                                  deadline_s=deadline_s, priority=priority)
+                                  deadline_s=deadline_s, priority=priority,
+                                  trace=trace)
 
     def tick(self) -> bool:
         """Advance the engine one scheduler pass, honoring injected

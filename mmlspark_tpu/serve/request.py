@@ -50,8 +50,8 @@ class Request:
 
     __slots__ = ("id", "prompt", "true_len", "bucket", "max_new_tokens",
                  "arrival", "deadline", "priority", "degraded", "tokens",
-                 "status", "detail", "finished_at", "span", "_event",
-                 "_progress", "listener")
+                 "status", "detail", "finished_at", "span", "trace",
+                 "_event", "_progress", "listener")
 
     def __init__(self, req_id: int, prompt: np.ndarray, bucket: int,
                  max_new_tokens: int, arrival: float, deadline: float,
@@ -70,6 +70,9 @@ class Request:
         self.detail: str = ""
         self.finished_at: Optional[float] = None
         self.span = None                      # serve.request trace span
+        self.trace = None                     # TraceContext (observe/trace):
+        #   minted at router admission (or locally for a bare engine) and
+        #   carried across every dispatch attempt and the KV handoff
         self._event = threading.Event()
         self._progress = threading.Condition()
         self.listener = None                  # optional progress callback

@@ -53,7 +53,8 @@ from mmlspark_tpu import config
 from mmlspark_tpu.observe.logging import get_logger
 from mmlspark_tpu.observe.metrics import inc_counter
 from mmlspark_tpu.observe.telemetry import active_run
-from mmlspark_tpu.observe.trace import trace_event
+from mmlspark_tpu.observe.trace import (mint_context, tail_promote,
+                                        trace_event)
 from mmlspark_tpu.resilience.breaker import (CLOSED, OPEN, STATE_CODES,
                                              CircuitOpenError)
 from mmlspark_tpu.resilience.clock import Clock, get_clock
@@ -286,8 +287,8 @@ class RouterRequest:
     __slots__ = ("id", "prompt", "true_len", "bucket", "max_new_tokens",
                  "arrival", "deadline", "priority", "degraded", "tokens",
                  "status", "detail", "finished_at", "retry_after_s",
-                 "attempts", "retries", "hedged", "span", "_event",
-                 "_progress")
+                 "attempts", "retries", "hedged", "span", "trace",
+                 "_event", "_progress")
 
     def __init__(self, req_id: int, prompt: np.ndarray, bucket: int,
                  max_new_tokens: int, arrival: float, deadline: float,
@@ -310,6 +311,8 @@ class RouterRequest:
         self.retries = 0
         self.hedged = False
         self.span = None
+        self.trace = None    # TraceContext minted at admission; every
+        #   attempt (failover, hedge) is a child of the SAME trace id
         self._event = threading.Event()
         self._progress = threading.Condition()
 
@@ -613,6 +616,12 @@ class Router:
                 self._complete(d, SHED, "displaced by interactive arrival",
                                retry_after=self.retry_after_s())
         self._count("admitted")
+        # mint the request's fleet-wide trace identity AT admission: the
+        # `admit` event is the waterfall root observe/assemble.py joins
+        # every downstream shard's records against
+        rr.trace = mint_context()
+        self._record_routing("admit", request=rr.id, priority=pri,
+                             bucket=bucket, **self._trace_fields(rr))
         with self._wake:
             self._wake.notify_all()
         return rr
@@ -628,6 +637,14 @@ class Router:
         trace_event(f"serve.route.{event}", cat="serve", **fields)
         inc_counter(f"serve.route.{event}")
 
+    @staticmethod
+    def _trace_fields(rr: RouterRequest) -> dict:
+        """The trace join fields a routing event carries (empty when the
+        request predates admission or tracing is off)."""
+        t = rr.trace
+        return {"trace": t.trace_id, "sampled": t.sampled} \
+            if t is not None else {}
+
     def _complete(self, rr: RouterRequest, status: str, detail: str = "",
                   retry_after: Optional[float] = None) -> None:
         now = self.now()
@@ -636,6 +653,20 @@ class Router:
         rr.finish(status, now, detail)
         self._count("finished")
         self._count(status)
+        fields = dict(request=rr.id, status=status, priority=rr.priority,
+                      latency_s=round(now - rr.arrival, 6),
+                      retries=rr.retries, hedged=rr.hedged,
+                      deadline_miss=bool(status == OK and now > rr.deadline),
+                      **self._trace_fields(rr))
+        # tail-based sampling: a head-unsampled request that finished
+        # badly (or slow, or needed a retry/hedge) is promoted to full
+        # waterfall detail — the bit itself never flips
+        tail = tail_promote(rr.trace, status=status,
+                            latency_s=now - rr.arrival,
+                            hedged=rr.hedged, retries=rr.retries)
+        if tail:
+            fields["tail"] = tail
+        self._record_routing("finish", **fields)
         if status == OK:
             self._latencies.append(now - rr.arrival)
             self._count("tokens_served", len(rr.tokens))
@@ -757,7 +788,9 @@ class Router:
         try:
             att = rep.submit(rr.prompt, rr.max_new_tokens,
                              deadline_s=max(1e-3, rr.deadline - now),
-                             priority=rr.priority)
+                             priority=rr.priority,
+                             trace=None if rr.trace is None else
+                             rr.trace.child(attempt=len(rr.attempts) + 1))
         except (Overloaded, ReplicaUnavailable, InvalidRequest) as e:
             if probe:
                 # the gate was opened for us; a refused probe is a
@@ -778,7 +811,10 @@ class Router:
             self._live.append(rr)
         self._record_routing("dispatch", request=rr.id, replica=rep.name,
                              probe=probe, attempt=len(rr.attempts),
-                             load=rep.load_tokens())
+                             load=rep.load_tokens(),
+                             **self._trace_fields(rr))
+        if self._run is not None and len(rr.attempts) == 1:
+            self._run.observe_hist("serve.queue_wait_s", now - rr.arrival)
         return att
 
     def _dispatch(self, now: float) -> bool:
@@ -829,7 +865,7 @@ class Router:
             self._live.remove(rr)
         self._count("handoff_retries")
         self._record_routing("handoff_failed", request=rr.id,
-                             reason=reason)
+                             reason=reason, **self._trace_fields(rr))
         self._failover(rr, now)
 
     def _failover(self, rr: RouterRequest, now: float) -> None:
@@ -845,7 +881,8 @@ class Router:
             return
         rr.retries += 1
         self._count("retries")
-        self._record_routing("failover", request=rr.id, retry=rr.retries)
+        self._record_routing("failover", request=rr.id, retry=rr.retries,
+                             **self._trace_fields(rr))
         # re-queue at the head: the retried attempt re-prefills from
         # scratch on whichever replica dispatch picks next tick (greedy
         # output stays byte-exact; the stream epoch bumps on dispatch)
@@ -952,7 +989,10 @@ class Router:
             try:
                 att = target.submit(rr.prompt, rr.max_new_tokens,
                                     deadline_s=remaining,
-                                    priority=rr.priority)
+                                    priority=rr.priority,
+                                    trace=None if rr.trace is None else
+                                    rr.trace.child(
+                                        attempt=len(rr.attempts) + 1))
             except (Overloaded, ReplicaUnavailable):
                 continue
             target.routed += 1
@@ -961,7 +1001,8 @@ class Router:
             self._count("hedges")
             self._record_routing("hedge", request=rr.id,
                                  replica=target.name,
-                                 remaining_s=round(remaining, 4))
+                                 remaining_s=round(remaining, 4),
+                                 **self._trace_fields(rr))
             progressed = True
         return progressed
 

@@ -55,6 +55,9 @@ python scripts/data_drill.py
 echo "== disagg drill (prefill-burst interference / torn-stalled-crashed handoff / prefill-tier drain) =="
 python scripts/disagg_drill.py
 
+echo "== trace drill (one trace id across crash-mid-handoff failover / waterfall + SLO accounting) =="
+python scripts/trace_drill.py
+
 echo "== bench smoke (JSON contract) =="
 python bench.py --smoke
 

@@ -60,6 +60,11 @@ Checks, per file:
     retry/breaker policies wrap EVERY byte on the wire;
     `native_loader.py` is whitelisted (its one `subprocess.run` compiles
     the optional native extension at import, pre-dating the service)
+  * raw id minting (`uuid.uuid4`, `secrets.token_*`, `os.urandom`)
+    inside `mmlspark_tpu/` outside `observe/trace.py` — request/trace
+    ids are minted in exactly one place (`new_trace_id`), so every id in
+    the fleet joins the single trace-id space the waterfall assembler
+    stitches shards on; a second mint site is an unjoinable id space
   * unregistered Pallas kernels in `mmlspark_tpu/ops/` — every module
     containing a `pallas_call` must have an entry in
     `PALLAS_PARITY_TESTS` mapping it to an existing parity-test file
@@ -162,6 +167,14 @@ PALLAS_PARITY_TESTS = {
     os.path.join("mmlspark_tpu", "ops", "decode_attention.py"):
         os.path.join("tests", "test_decode_attention.py"),
 }
+
+# distributed tracing: request/trace id MINTING is owned exclusively by
+# observe/trace.py (new_trace_id/mint_context) — an id minted anywhere
+# else (uuid, secrets, os.urandom) starts a parallel id space that can
+# never be joined across shards by the waterfall assembler
+TRACE_MINT_FILE = os.path.join("mmlspark_tpu", "observe", "trace.py")
+_ID_MINT_CALLS = ("uuid1", "uuid4", "token_hex", "token_bytes",
+                  "token_urlsafe", "urandom")
 
 # the parallel package: with_sharding_constraint / NamedSharding
 # construction anywhere else in mmlspark_tpu/ bypasses the partition
@@ -329,6 +342,25 @@ def _in_package(path: str) -> bool:
             and norm not in PRINT_WHITELIST)
 
 
+def _in_id_mint_policy(path: str) -> bool:
+    norm = os.path.normpath(path)
+    return (norm.startswith(PACKAGE_DIR + os.sep)
+            and norm != TRACE_MINT_FILE)
+
+
+def _is_id_mint_call(node: ast.Call) -> bool:
+    """Matches `uuid.uuid4()`, `secrets.token_hex()`, `os.urandom()` and
+    their bare from-import forms (`uuid4()`, `token_hex()`,
+    `urandom()`) — the id-generation calls observe/trace.py owns
+    exclusively within mmlspark_tpu/."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id in _ID_MINT_CALLS
+    return (isinstance(fn, ast.Attribute) and fn.attr in _ID_MINT_CALLS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in ("uuid", "secrets", "os"))
+
+
 def _in_sharding_policy(path: str) -> bool:
     norm = os.path.normpath(path)
     return (norm.startswith(PACKAGE_DIR + os.sep)
@@ -448,6 +480,7 @@ def check_file(path: str) -> list[str]:
     in_data_policy = _in_data_policy(path)
     in_transport_policy = _in_transport_policy(path)
     in_sharding_policy = _in_sharding_policy(path)
+    in_id_mint_policy = _in_id_mint_policy(path)
     in_ops = _in_ops(path)
     pallas_line = None
     for node in ast.walk(tree):
@@ -468,6 +501,14 @@ def check_file(path: str) -> list[str]:
                     f"shardings via parallel.partition.named_sharding/"
                     f"tree_shardings (or mesh.py helpers) so placement "
                     f"stays behind the partition registry")
+        if in_id_mint_policy and isinstance(node, ast.Call) \
+                and _is_id_mint_call(node):
+            problems.append(
+                f"{path}:{node.lineno}: raw id minting (uuid/secrets/"
+                f"os.urandom) inside mmlspark_tpu/ outside observe/"
+                f"trace.py — request/trace ids come from observe.trace."
+                f"new_trace_id/mint_context so every id joins the one "
+                f"trace-id space the waterfall assembler stitches on")
         if in_transport_policy and isinstance(node, ast.Call):
             if _is_raw_socket_ctor(node):
                 problems.append(

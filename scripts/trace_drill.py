@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Distributed-tracing drill: prove one trace id survives a fleet fault.
+
+Two scenarios through the `Scenario` DSL (resilience/chaos.py), each
+driving a REAL tiered router over REAL engine replicas under a
+`VirtualClock` (zero sleeps) with telemetry recording:
+
+  trace_crash_mid_handoff  the headline claim: a prefill replica dies
+                           after shipping the first KV page.  The
+                           watchdog fails the transfer, the router
+                           fails over, the survivor re-prefills — and
+                           the whole chain (admit, dispatch, handoff
+                           begin, transfer_failed, failover,
+                           re-dispatch, second handoff, splice, finish)
+                           carries ONE trace id.  The assembled
+                           waterfall shows BOTH attempts (two queue
+                           openings, two handoff segments), its stage
+                           durations sum exactly to the wall, and the
+                           SLO accountant counts the request ONCE —
+                           retries spend latency, not request count.
+  trace_clean_path         the no-fault control: every request's
+                           waterfall has one attempt, no orphans, and
+                           /tracez-style assembly agrees with the
+                           router's own status counts.
+
+Exit 0 only when every scenario passes.  `make trace-drill` is the
+entry point; scripts/check.sh runs it in the gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from disagg_drill import SHORT, drive, make_tiers, prompts_for  # noqa: E402
+from serve_drill import build_bundle  # noqa: E402
+
+
+def _assembly_obs(requests):
+    """Waterfall/SLO facts every scenario asserts on, read from the
+    active run the same way /tracez does."""
+    from mmlspark_tpu.observe.assemble import assemble
+    from mmlspark_tpu.observe.slo import compute_slo
+    from mmlspark_tpu.observe.telemetry import active_run
+
+    run = active_run()
+    out = assemble(run.tracer.records())
+    by_trace = {w["trace"]: w for w in out["waterfalls"]}
+    tids = {r.trace.trace_id for r in requests if r.trace is not None}
+    stitched = sum(1 for t in tids if t in by_trace)
+    sums_ok = all(
+        abs(by_trace[t]["stages_sum_s"] - by_trace[t]["wall_s"]) < 1e-6
+        for t in tids if t in by_trace)
+    slo = compute_slo(run._serve, run._routing, now=run.tracer.now())
+    slo_requests = sum(ep["requests"] for ep in slo["endpoints"].values())
+    return by_trace, {
+        "traced": len(tids), "stitched": stitched,
+        "orphans": len(out["orphans"]),
+        "stage_sums_match_wall": sums_ok,
+        "slo_requests": slo_requests,
+    }
+
+
+def _status_obs(requests, obs):
+    obs.update({
+        "ok": sum(1 for r in requests if r.status == "ok"),
+        "unfinished": sum(1 for r in requests if not r.finished),
+    })
+    return obs
+
+
+def scenario_trace_crash_mid_handoff(bundle):
+    """Crash a prefill replica mid-transfer: the failover chain keeps one
+    trace id, the waterfall shows both attempts, SLO counts one request
+    per submission."""
+    from mmlspark_tpu.resilience.chaos import Fault, Scenario, run_scenario
+    from mmlspark_tpu.resilience.clock import VirtualClock
+
+    scenario = Scenario(
+        "trace_crash_mid_handoff",
+        faults=[Fault(kind="prefill_crash_mid_transfer", at_request=2)],
+        expect={"ok": 4, "unfinished": 0, "orphans": 0,
+                "traced": 4, "stitched": 4,
+                "stage_sums_match_wall": True,
+                "one_trace_across_attempts": True,
+                "min_failover_attempts": 2,
+                "min_failover_handoff_segments": 2,
+                "min_failover_queue_segments": 2,
+                "slo_requests": 4})
+
+    def run():
+        clock = VirtualClock()
+        router = make_tiers(bundle, clock, prefill=2, decode=1)
+        router.warmup()
+        prompts = prompts_for(31, 2, SHORT) + prompts_for(32, 2, 14)
+        requests = [router.submit(p) for p in prompts]
+        drive(router, clock, requests)
+        by_trace, obs = _assembly_obs(requests)
+        victim = next((r for r in requests if len(r.attempts) >= 2), None)
+        obs["one_trace_across_attempts"] = False
+        if victim is not None and victim.trace is not None:
+            wf = by_trace.get(victim.trace.trace_id)
+            if wf is not None:
+                # the router never re-minted: every record of the retry
+                # chain joined the SAME waterfall, attempts advancing
+                obs["one_trace_across_attempts"] = True
+                obs["failover_attempts"] = wf["attempts"]
+                segs = wf.get("segments", [])
+                obs["failover_handoff_segments"] = sum(
+                    1 for s in segs if s["stage"] == "handoff")
+                obs["failover_queue_segments"] = sum(
+                    1 for s in segs if s["stage"] == "queue")
+        return _status_obs(requests, obs)
+
+    return run_scenario(scenario, run)
+
+
+def scenario_trace_clean_path(bundle):
+    """No faults: one attempt per waterfall, zero orphans, and assembly
+    agrees with the router's own completion counts."""
+    from mmlspark_tpu.resilience.chaos import Scenario, run_scenario
+    from mmlspark_tpu.resilience.clock import VirtualClock
+
+    scenario = Scenario(
+        "trace_clean_path",
+        faults=[],
+        expect={"ok": 4, "unfinished": 0, "orphans": 0,
+                "traced": 4, "stitched": 4,
+                "stage_sums_match_wall": True,
+                "max_attempts_seen": 1})
+
+    def run():
+        clock = VirtualClock()
+        router = make_tiers(bundle, clock, prefill=2, decode=1)
+        router.warmup()
+        prompts = prompts_for(51, 2, SHORT) + prompts_for(52, 2, 14)
+        requests = [router.submit(p) for p in prompts]
+        drive(router, clock, requests)
+        by_trace, obs = _assembly_obs(requests)
+        obs["attempts_seen"] = max(
+            (by_trace[r.trace.trace_id]["attempts"] for r in requests
+             if r.trace is not None and r.trace.trace_id in by_trace),
+            default=0)
+        return _status_obs(requests, obs)
+
+    return run_scenario(scenario, run)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report only")
+    args = parser.parse_args()
+
+    from mmlspark_tpu.observe.telemetry import run_telemetry
+
+    bundle = build_bundle()
+    reports = []
+    # one run_telemetry per scenario: each asserts over ITS OWN shard
+    # set, so the clean-path control can't see the crash scenario's spans
+    for scenario_fn in (scenario_trace_crash_mid_handoff,
+                        scenario_trace_clean_path):
+        with tempfile.TemporaryDirectory() as td:
+            with run_telemetry(td):
+                reports.append(scenario_fn(bundle))
+
+    passed = all(r["passed"] for r in reports)
+    if args.json:
+        print(json.dumps({"passed": passed, "scenarios": reports}))
+    else:
+        for r in reports:
+            status = "PASS" if r["passed"] else "FAIL"
+            print(f"[{status}] {r['name']}")
+            for key, c in r["checks"].items():
+                mark = "ok" if c["ok"] else "WANT %r GOT %r" % (
+                    c["want"], c["got"])
+                print(f"    {key}: {mark}")
+            if not r["passed"]:
+                print(f"    observed: {r['observed']}")
+        print("TRACE DRILL " + ("OK" if passed else "FAILED"))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

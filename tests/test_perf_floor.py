@@ -267,6 +267,14 @@ def test_bench_serve_contract_fields():
     assert result["prefix_greedy_match"] is True
     assert result["prefix_hit_rate"] > 0.5, result
     assert 0.0 < result["prefix_suffix_prefill_fraction"] < 0.5, result
+    # the tracing-overhead arm (docs/observability.md "Distributed
+    # tracing"): per-request TraceContext minting + record stamping +
+    # tail promotion at head-sample 0.0, recording into a real run,
+    # must cost <= 3% goodput vs the same engine with tracing off —
+    # the ISSUE-20 acceptance gate that keeps tracing default-on
+    assert result["trace_off_goodput_tokens_per_sec"] > 0
+    assert result["trace_on_goodput_tokens_per_sec"] > 0
+    assert result["trace_overhead"] <= 0.03, result
 
 
 @pytest.mark.slow
